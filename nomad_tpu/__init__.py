@@ -1,0 +1,27 @@
+"""nomad_tpu — a TPU-native cluster-scheduling framework.
+
+A ground-up re-architecture of a Nomad-class workload orchestrator
+(reference: goatmale/nomad v1.2.3-dev) in which the host control plane
+(state store, eval broker, plan queue, serialized plan applier, client
+runners) stays conventional Python/C++, while the per-evaluation placement
+decision — feasibility filtering, bin-pack/spread/affinity scoring, and
+preemption victim search — runs as compiled JAX/XLA device programs over a
+dense ``evals × nodes × resource-dims`` tensor representation of the
+cluster.
+
+Layer map (mirrors SURVEY.md §1):
+
+- ``nomad_tpu.structs``    — the shared data model (Job/Node/Alloc/Eval/Plan).
+- ``nomad_tpu.state``      — MVCC snapshot state store with index watermarks.
+- ``nomad_tpu.device``     — cluster flattening + JAX placement/score kernels.
+- ``nomad_tpu.parallel``   — mesh/sharding policy for multi-chip scaling.
+- ``nomad_tpu.scheduler``  — reconciler + generic/system schedulers (host logic).
+- ``nomad_tpu.broker``     — eval broker, blocked evals, plan queue, plan applier.
+- ``nomad_tpu.server``     — the agent composition root: workers, heartbeats.
+- ``nomad_tpu.client``     — node agent: fingerprinting, alloc/task runners.
+- ``nomad_tpu.api``        — HTTP API + Python SDK.
+- ``nomad_tpu.cli``        — command-line interface.
+"""
+
+__version__ = "0.1.0"
+SCHEDULER_VERSION = 1  # mirrors scheduler/scheduler.go:18 (SchedulerVersion)

@@ -1,0 +1,294 @@
+"""Job / TaskGroup / Task model with constraints, affinities and spreads.
+
+Reference shapes: nomad/structs/structs.go (Job ~:3900, TaskGroup ~:5610,
+Task ~:6090, Constraint ~:7600, Affinity ~:7700, Spread ~:7800). Only the
+scheduling-relevant surface is modeled; service discovery, vault/consul
+blocks, and template hooks are client-side concerns added in later layers.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .resources import Resources
+
+# Job types — structs.go JobTypeService/Batch/System/SysBatch + core GC jobs.
+JOB_TYPE_SERVICE = "service"
+JOB_TYPE_BATCH = "batch"
+JOB_TYPE_SYSTEM = "system"
+JOB_TYPE_SYSBATCH = "sysbatch"
+JOB_TYPE_CORE = "_core"
+
+JOB_STATUS_PENDING = "pending"
+JOB_STATUS_RUNNING = "running"
+JOB_STATUS_DEAD = "dead"
+
+JOB_DEFAULT_PRIORITY = 50
+JOB_MIN_PRIORITY = 1
+JOB_MAX_PRIORITY = 100
+
+DEFAULT_NAMESPACE = "default"
+
+# Constraint operands — scheduler/feasible.go:785-820 checkConstraint dispatch.
+CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"
+CONSTRAINT_DISTINCT_PROPERTY = "distinct_property"
+CONSTRAINT_REGEX = "regexp"
+CONSTRAINT_VERSION = "version"
+CONSTRAINT_SEMVER = "semver"
+CONSTRAINT_SET_CONTAINS = "set_contains"
+CONSTRAINT_SET_CONTAINS_ALL = "set_contains_all"
+CONSTRAINT_SET_CONTAINS_ANY = "set_contains_any"
+CONSTRAINT_ATTRIBUTE_IS_SET = "is_set"
+CONSTRAINT_ATTRIBUTE_IS_NOT_SET = "is_not_set"
+
+COMPARISON_OPERANDS = ("=", "==", "is", "!=", "not", "<", "<=", ">", ">=")
+
+
+@dataclass(slots=True)
+class Constraint:
+    """Hard placement constraint. Reference: structs.Constraint."""
+
+    l_target: str = ""
+    r_target: str = ""
+    operand: str = "="
+
+    def key(self) -> tuple:
+        return (self.l_target, self.r_target, self.operand)
+
+
+@dataclass(slots=True)
+class Affinity:
+    """Soft placement preference with weight in [-100, 100].
+    Reference: structs.Affinity; scored in scheduler/rank.go:650-737."""
+
+    l_target: str = ""
+    r_target: str = ""
+    operand: str = "="
+    weight: int = 50
+
+
+@dataclass(slots=True)
+class SpreadTarget:
+    value: str = ""
+    percent: int = 0
+
+
+@dataclass(slots=True)
+class Spread:
+    """Spread allocations over values of an attribute, optionally with
+    per-value target percentages. Reference: structs.Spread; scored in
+    scheduler/spread.go."""
+
+    attribute: str = ""
+    weight: int = 50
+    targets: list[SpreadTarget] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class RestartPolicy:
+    attempts: int = 2
+    interval_s: float = 1800.0
+    delay_s: float = 15.0
+    mode: str = "fail"  # fail | delay
+
+
+@dataclass(slots=True)
+class ReschedulePolicy:
+    """Controls replacement of failed allocs on new nodes.
+    Reference: structs.ReschedulePolicy; consumed by the reconciler and
+    generic_sched.go:718-753 (followup evals with backoff)."""
+
+    attempts: int = 0
+    interval_s: float = 0.0
+    delay_s: float = 30.0
+    delay_function: str = "exponential"  # constant | exponential | fibonacci
+    max_delay_s: float = 3600.0
+    unlimited: bool = True
+
+
+@dataclass(slots=True)
+class MigrateStrategy:
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+
+
+@dataclass(slots=True)
+class UpdateStrategy:
+    """Deployment/rolling-update knobs. Reference: structs.UpdateStrategy;
+    consumed by the reconciler's deployment logic (scheduler/reconcile.go)."""
+
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+    progress_deadline_s: float = 600.0
+    auto_revert: bool = False
+    auto_promote: bool = False
+    canary: int = 0
+    stagger_s: float = 30.0
+
+    def rolling(self) -> bool:
+        return self.max_parallel > 0
+
+
+@dataclass(slots=True)
+class EphemeralDisk:
+    size_mb: int = 300
+    sticky: bool = False
+    migrate: bool = False
+
+
+@dataclass(slots=True)
+class PeriodicConfig:
+    """Cron-style launch config. Reference: structs.PeriodicConfig;
+    driven by the leader's periodic dispatcher (nomad/periodic.go)."""
+
+    enabled: bool = True
+    spec: str = ""
+    spec_type: str = "cron"
+    prohibit_overlap: bool = False
+    time_zone: str = "UTC"
+
+
+@dataclass(slots=True)
+class ParameterizedJobConfig:
+    payload: str = "optional"
+    meta_required: list[str] = field(default_factory=list)
+    meta_optional: list[str] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class Task:
+    """One process under a driver. Reference: structs.Task."""
+
+    name: str = "task"
+    driver: str = "exec"
+    user: str = ""
+    config: dict = field(default_factory=dict)
+    env: dict[str, str] = field(default_factory=dict)
+    resources: Resources = field(default_factory=Resources)
+    constraints: list[Constraint] = field(default_factory=list)
+    affinities: list[Affinity] = field(default_factory=list)
+    meta: dict[str, str] = field(default_factory=dict)
+    leader: bool = False
+    kill_timeout_s: float = 5.0
+    lifecycle_hook: str = ""  # "" (main) | prestart | poststart | poststop
+    lifecycle_sidecar: bool = False
+    artifacts: list[dict] = field(default_factory=list)
+    templates: list[dict] = field(default_factory=list)
+    kind: str = ""
+
+
+@dataclass(slots=True)
+class TaskGroup:
+    """A co-scheduled set of tasks; the unit of placement.
+    Reference: structs.TaskGroup."""
+
+    name: str = "group"
+    count: int = 1
+    tasks: list[Task] = field(default_factory=list)
+    constraints: list[Constraint] = field(default_factory=list)
+    affinities: list[Affinity] = field(default_factory=list)
+    spreads: list[Spread] = field(default_factory=list)
+    restart_policy: RestartPolicy = field(default_factory=RestartPolicy)
+    reschedule_policy: Optional[ReschedulePolicy] = None
+    ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
+    update: Optional[UpdateStrategy] = None
+    migrate: Optional[MigrateStrategy] = None
+    networks: list = field(default_factory=list)
+    stop_after_client_disconnect_s: Optional[float] = None
+    meta: dict[str, str] = field(default_factory=dict)
+
+    def combined_resources(self) -> Resources:
+        """Sum of task asks + ephemeral disk, the group's placement ask."""
+        out = Resources(cpu=0, memory_mb=0, disk_mb=self.ephemeral_disk.size_mb)
+        for t in self.tasks:
+            out.cpu += t.resources.cpu
+            out.memory_mb += t.resources.memory_mb
+            out.networks.extend(t.resources.networks)
+            out.devices.extend(t.resources.devices)
+        out.networks = list(out.networks) + list(self.networks)
+        return out
+
+
+@dataclass(slots=True)
+class Job:
+    """Reference: structs.Job. ``version`` increments on every mutating
+    registration; the reconciler compares alloc.job_version to decide
+    in-place vs destructive updates."""
+
+    id: str = ""
+    name: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    type: str = JOB_TYPE_SERVICE
+    priority: int = JOB_DEFAULT_PRIORITY
+    region: str = "global"
+    datacenters: list[str] = field(default_factory=lambda: ["dc1"])
+    all_at_once: bool = False
+    constraints: list[Constraint] = field(default_factory=list)
+    affinities: list[Affinity] = field(default_factory=list)
+    spreads: list[Spread] = field(default_factory=list)
+    task_groups: list[TaskGroup] = field(default_factory=list)
+    periodic: Optional[PeriodicConfig] = None
+    parameterized: Optional[ParameterizedJobConfig] = None
+    parent_id: str = ""
+    payload: bytes = b""
+    meta: dict[str, str] = field(default_factory=dict)
+    status: str = JOB_STATUS_PENDING
+    stop: bool = False
+    stable: bool = False
+    version: int = 0
+    submit_time_ns: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    job_modify_index: int = 0
+
+    def lookup_task_group(self, name: str) -> Optional[TaskGroup]:
+        for tg in self.task_groups:
+            if tg.name == name:
+                return tg
+        return None
+
+    def is_periodic(self) -> bool:
+        return self.periodic is not None
+
+    def is_parameterized(self) -> bool:
+        return self.parameterized is not None
+
+    def stopped(self) -> bool:
+        return self.stop
+
+    def terminal(self) -> bool:
+        return self.stop and self.status == JOB_STATUS_DEAD
+
+    def required_allocs(self) -> dict[str, int]:
+        """group name → desired count (0 when the job is stopped)."""
+        if self.stop:
+            return {tg.name: 0 for tg in self.task_groups}
+        return {tg.name: tg.count for tg in self.task_groups}
+
+    def constraints_for_group(self, tg: TaskGroup) -> list[Constraint]:
+        """Job + group + per-task constraints, the full hard-constraint set
+        for a placement (mirrors how the stack layers ConstraintCheckers
+        across job/group/task scopes). Implicit driver constraints are
+        added separately by the feasibility layer."""
+        out = list(itertools.chain(self.constraints, tg.constraints))
+        for t in tg.tasks:
+            out.extend(t.constraints)
+        return out
+
+    def affinities_for_group(self, tg: TaskGroup) -> list[Affinity]:
+        out = list(itertools.chain(self.affinities, tg.affinities))
+        for t in tg.tasks:
+            out.extend(t.affinities)
+        return out
+
+    def spreads_for_group(self, tg: TaskGroup) -> list[Spread]:
+        return list(itertools.chain(self.spreads, tg.spreads))
+
+    def namespaced_id(self) -> tuple[str, str]:
+        return (self.namespace, self.id)

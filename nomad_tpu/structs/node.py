@@ -1,0 +1,118 @@
+"""Node model: fingerprinted attributes, capacity, drain/eligibility state.
+
+Reference: structs.Node (nomad/structs/structs.go ~:1900), computed node
+class (nomad/structs/node_class.go) — the memoization key that lets
+feasibility be evaluated once per *class* instead of once per node
+(scheduler/feasible.go:1029-1153). In the TPU design the computed class is
+also the unit at which host-side regex/semver constraints are pre-evaluated
+before being broadcast into the device eligibility mask.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .resources import NodeReservedResources, NodeResources
+
+NODE_STATUS_INIT = "initializing"
+NODE_STATUS_READY = "ready"
+NODE_STATUS_DOWN = "down"
+
+NODE_SCHED_ELIGIBLE = "eligible"
+NODE_SCHED_INELIGIBLE = "ineligible"
+
+
+@dataclass(slots=True)
+class DrainStrategy:
+    """Reference: structs.DrainStrategy."""
+
+    deadline_s: float = 0.0  # <0: force drain now; 0: no deadline
+    ignore_system_jobs: bool = False
+    force_deadline_unix: float = 0.0
+
+
+@dataclass(slots=True)
+class Node:
+    id: str = ""
+    name: str = ""
+    datacenter: str = "dc1"
+    node_class: str = ""
+    attributes: dict[str, str] = field(default_factory=dict)
+    meta: dict[str, str] = field(default_factory=dict)
+    links: dict[str, str] = field(default_factory=dict)
+    node_resources: NodeResources = field(default_factory=NodeResources)
+    reserved: NodeReservedResources = field(default_factory=NodeReservedResources)
+    drivers: dict[str, bool] = field(default_factory=dict)  # driver → healthy
+    status: str = NODE_STATUS_INIT
+    status_description: str = ""
+    scheduling_eligibility: str = NODE_SCHED_ELIGIBLE
+    drain: Optional[DrainStrategy] = None
+    computed_class: str = ""
+    status_updated_at: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+
+    def ready(self) -> bool:
+        """Node can accept new work — structs.Node.Ready()."""
+        return (
+            self.status == NODE_STATUS_READY
+            and self.drain is None
+            and self.scheduling_eligibility == NODE_SCHED_ELIGIBLE
+        )
+
+    def terminal_status(self) -> bool:
+        return self.status == NODE_STATUS_DOWN
+
+    def compute_class(self) -> None:
+        """Hash scheduling-relevant fields into ``computed_class``.
+        Mirrors structs.Node.ComputeClass (node_class.go): nodes with equal
+        hashes are interchangeable for feasibility, enabling per-class
+        memoization and, here, per-class host pre-evaluation of constraint
+        operators the device can't run (regex/version)."""
+        h = hashlib.blake2b(digest_size=8)
+        h.update(self.datacenter.encode())
+        h.update(self.node_class.encode())
+        for k in sorted(self.attributes):
+            if k.startswith("unique."):
+                continue
+            h.update(k.encode())
+            h.update(str(self.attributes[k]).encode())
+        for k in sorted(self.meta):
+            if k.startswith("unique."):
+                continue
+            h.update(k.encode())
+            h.update(str(self.meta[k]).encode())
+        for d in sorted(self.drivers):
+            if self.drivers[d]:
+                h.update(d.encode())
+        h.update(self.node_resources.to_vector().tobytes())
+        self.computed_class = "v1:" + h.hexdigest()
+
+    def lookup_attribute(self, target: str) -> Optional[str]:
+        """Resolve a constraint LTarget like ``${attr.kernel.name}``,
+        ``${node.datacenter}``, ``${meta.rack}`` against this node.
+        Mirrors scheduler/feasible.go:748-781 (resolveTarget)."""
+        t = target
+        if t.startswith("${") and t.endswith("}"):
+            t = t[2:-1]
+        if t == "node.unique.id":
+            return self.id
+        if t == "node.unique.name":
+            return self.name
+        if t == "node.datacenter":
+            return self.datacenter
+        if t == "node.region":
+            return self.attributes.get("platform.region", "global")
+        if t == "node.class":
+            return self.node_class
+        if t.startswith("attr."):
+            return self.attributes.get(t[len("attr."):])
+        if t.startswith("meta."):
+            return self.meta.get(t[len("meta."):])
+        if t.startswith("node.attr."):
+            return self.attributes.get(t[len("node.attr."):])
+        if t.startswith("node.meta."):
+            return self.meta.get(t[len("node.meta."):])
+        return None

@@ -1,0 +1,194 @@
+"""Unit tests for the shared data model (nomad_tpu.structs).
+
+Mirrors the reference's table-driven funcs.go tests
+(nomad/structs/funcs_test.go: TestAllocsFit*, TestScoreFitBinPack)."""
+
+import math
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    BINPACK_MAX_SCORE,
+    Allocation,
+    ComparableResources,
+    NetworkIndex,
+    NetworkResource,
+    allocs_fit,
+    score_fit_binpack,
+    score_fit_spread,
+)
+from nomad_tpu.structs.resources import NodeReservedResources, NodeResources
+
+
+def make_node(cpu=2000, mem=2048, disk=10000, rcpu=0, rmem=0):
+    return mock.node(
+        node_resources=NodeResources(cpu=cpu, memory_mb=mem, disk_mb=disk),
+        reserved=NodeReservedResources(cpu=rcpu, memory_mb=rmem),
+    )
+
+
+def alloc_using(cpu, mem, disk=0):
+    return Allocation(
+        resources=ComparableResources(cpu=cpu, memory_mb=mem, disk_mb=disk),
+        client_status="running",
+    )
+
+
+class TestAllocsFit:
+    def test_empty_fits(self):
+        ok, dim, used = allocs_fit(make_node(), [])
+        assert ok and dim == ""
+        assert used.cpu == 0
+
+    def test_exact_fit(self):
+        ok, _, used = allocs_fit(make_node(), [alloc_using(2000, 2048)])
+        assert ok
+        assert used.cpu == 2000 and used.memory_mb == 2048
+
+    @pytest.mark.parametrize(
+        "cpu,mem,dim",
+        [(2001, 10, "cpu"), (10, 2049, "memory"), (3000, 3000, "cpu")],
+    )
+    def test_overcommit_fails(self, cpu, mem, dim):
+        ok, got_dim, _ = allocs_fit(make_node(), [alloc_using(cpu, mem)])
+        assert not ok and got_dim == dim
+
+    def test_reserved_counts_against_capacity(self):
+        # funcs.go:147-210 — node reserved resources are pre-added to used.
+        node = make_node(rcpu=500, rmem=512)
+        ok, _, _ = allocs_fit(node, [alloc_using(1501, 10)])
+        assert not ok
+        ok, _, _ = allocs_fit(node, [alloc_using(1500, 1536)])
+        assert ok
+
+    def test_multiple_allocs_sum(self):
+        allocs = [alloc_using(800, 800) for _ in range(3)]
+        ok, _, _ = allocs_fit(make_node(), allocs)
+        assert not ok
+        ok, _, _ = allocs_fit(make_node(cpu=3000, mem=3000), allocs)
+        assert ok
+
+    def test_disk_dimension(self):
+        ok, dim, _ = allocs_fit(make_node(), [alloc_using(10, 10, disk=999999)])
+        assert not ok and dim == "disk"
+
+    def test_terminal_allocs_skipped(self):
+        # funcs.go AllocsFit: `if alloc.TerminalStatus() { continue }`
+        dead = alloc_using(2000, 2048)
+        dead.client_status = "complete"
+        ok, _, used = allocs_fit(make_node(), [dead, alloc_using(500, 500)])
+        assert ok
+        assert used.cpu == 500
+
+
+class TestScoreReservedDenominator:
+    def test_reserved_adjusted_free_fraction(self):
+        # computeFreePercentage subtracts reserved from the denominator:
+        # cpu=2000 reserved=1000, used=0 ⇒ freeCpu = 1.0, not 0.5.
+        node = make_node(cpu=2000, mem=2048, rcpu=1000, rmem=1024)
+        assert score_fit_binpack(node, ComparableResources()) == pytest.approx(0.0)
+        full = ComparableResources(cpu=1000, memory_mb=1024)
+        assert score_fit_binpack(node, full) == pytest.approx(BINPACK_MAX_SCORE)
+
+
+class TestScoreFit:
+    def test_empty_node_scores_zero(self):
+        # 20 - 10^1 - 10^1 = 0 for a fully-free node (funcs.go:236-256).
+        node = make_node()
+        assert score_fit_binpack(node, ComparableResources()) == 0.0
+
+    def test_full_node_scores_max(self):
+        node = make_node(cpu=2000, mem=2048)
+        used = ComparableResources(cpu=2000, memory_mb=2048)
+        assert score_fit_binpack(node, used) == pytest.approx(BINPACK_MAX_SCORE)
+
+    def test_half_used(self):
+        node = make_node(cpu=2000, mem=2048)
+        used = ComparableResources(cpu=1000, memory_mb=1024)
+        expected = 20.0 - 2 * math.pow(10, 0.5)
+        assert score_fit_binpack(node, used) == pytest.approx(expected)
+
+    def test_binpack_monotone_in_utilization(self):
+        node = make_node(cpu=2000, mem=2048)
+        scores = [
+            score_fit_binpack(
+                node, ComparableResources(cpu=c, memory_mb=c)
+            )
+            for c in (0, 500, 1000, 1500, 2000)
+        ]
+        assert scores == sorted(scores)
+
+    def test_spread_is_inverse(self):
+        node = make_node(cpu=2000, mem=2048)
+        empty = score_fit_spread(node, ComparableResources())
+        full = score_fit_spread(node, ComparableResources(cpu=2000, memory_mb=2048))
+        assert empty == pytest.approx(BINPACK_MAX_SCORE)
+        assert full == pytest.approx(0.0)
+
+
+class TestNetworkIndex:
+    def test_reserved_port_collision(self):
+        idx = NetworkIndex(mock.node())
+        ask = NetworkResource(mbits=10, reserved_ports=[8080])
+        offer, err = idx.assign_network(ask)
+        assert offer is not None and err == ""
+        idx.commit(offer)
+        offer2, err2 = idx.assign_network(ask)
+        assert offer2 is None and "8080" in err2
+
+    def test_bandwidth_exhaustion(self):
+        idx = NetworkIndex(mock.node())
+        idx.avail_bandwidth = 100
+        offer, _ = idx.assign_network(NetworkResource(mbits=80))
+        idx.commit(offer)
+        offer2, err = idx.assign_network(NetworkResource(mbits=30))
+        assert offer2 is None and "bandwidth" in err
+
+    def test_dynamic_ports_unique(self):
+        idx = NetworkIndex(mock.node())
+        ask = NetworkResource(dynamic_ports=["http", "https", "db"])
+        offer, err = idx.assign_network(ask)
+        assert err == ""
+        ports = [p.value for p in offer.dynamic_ports]
+        assert len(set(ports)) == 3
+        assert all(20000 <= p <= 32000 for p in ports)
+
+
+class TestJobModel:
+    def test_required_allocs(self):
+        j = mock.job()
+        assert j.required_allocs() == {"web": 10}
+        j.stop = True
+        assert j.required_allocs() == {"web": 0}
+
+    def test_combined_resources(self):
+        j = mock.job()
+        ask = j.task_groups[0].combined_resources()
+        assert ask.cpu == 500 and ask.memory_mb == 256
+        assert ask.disk_mb == 300  # ephemeral disk default
+
+    def test_alloc_index_parse(self):
+        a = mock.alloc()
+        assert a.name.endswith("[0]")
+        assert a.index() == 0
+
+    def test_node_computed_class_stable(self):
+        n1 = mock.node(name="a")
+        n2 = mock.node(name="b")
+        # name is not part of the class hash; same attrs ⇒ same class
+        assert n1.computed_class == n2.computed_class
+        n3 = mock.node(node_class="gpu")
+        assert n3.computed_class != n1.computed_class
+
+    def test_reschedule_backoff(self):
+        from nomad_tpu.structs import ReschedulePolicy, RescheduleTracker, RescheduleEvent
+
+        a = mock.alloc()
+        pol = ReschedulePolicy(delay_s=30, delay_function="exponential", max_delay_s=400)
+        a.reschedule_tracker = RescheduleTracker(
+            events=[RescheduleEvent(), RescheduleEvent(), RescheduleEvent()]
+        )
+        assert a.next_reschedule_delay(pol) == 30 * 2**3
+        a.reschedule_tracker.events.extend([RescheduleEvent()] * 10)
+        assert a.next_reschedule_delay(pol) == 400
