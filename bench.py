@@ -1,0 +1,181 @@
+"""Benchmark: plan a C2M-scale allocation wave against a 10k-node cluster.
+
+North star (BASELINE.md): plan 100k pending allocations against 10k nodes
+in < 1 s on a v5e-8 ⇒ 100k allocs/s, i.e. a 12.5k allocs/s per-chip share.
+This bench runs the real placement path — flatten once (the resident
+device-array cache), then the batched greedy placement kernel
+(nomad_tpu.device.score.place_batch_kernel) planning 100 jobs × 1000
+instances = 100,000 allocations — on whatever single device is available
+(TPU v5e under axon; CPU fallback) and reports allocations planned per
+second. ``vs_baseline`` is measured ÷ 12,500 (the per-chip north-star
+share), so ≥ 1.0 beats the target.
+
+Reference comparison point: the Go scheduler walks O(allocs × log₂(nodes)
+× iterator stages) sequentially per worker (scheduler/stack.go:83-90,
+rank.go:193-527); its micro-bench grid is scheduler/benchmarks/
+benchmarks_test.go:71-124.
+
+Prints exactly ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _ensure_live_backend(timeout_s: float = 90.0) -> None:
+    """The axon TPU plugin can hang jax.devices() indefinitely when its
+    tunnel is down. Probe in a daemon thread; on timeout, re-exec this
+    process on the CPU backend so the driver always gets its JSON line."""
+    if os.environ.get("NOMAD_TPU_BENCH_FALLBACK"):
+        return
+    import threading
+
+    ok: list[bool] = []
+
+    def probe():
+        try:
+            import jax
+
+            jax.devices()
+            ok.append(True)
+        except Exception:
+            pass
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if ok:
+        return
+    env = dict(os.environ)
+    env["NOMAD_TPU_BENCH_FALLBACK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
+    )
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
+
+
+def build_cluster(n_nodes: int, seed: int = 42):
+    """Synthetic heterogeneous cluster as resident device tensors
+    (4/8/16-core classes, 3 datacenters), bypassing the Python struct
+    walk — mirrors the design's steady state where device arrays are a
+    derived cache refreshed incrementally (SURVEY.md §7 'latency floor')."""
+    from nomad_tpu.device.flatten import ClusterTensors, node_bucket
+
+    rng = np.random.default_rng(seed)
+    pn = node_bucket(n_nodes)
+    classes = rng.integers(0, 3, size=n_nodes)
+    cpu = np.choose(classes, [4000, 8000, 16000]).astype(np.float32)
+    mem = np.choose(classes, [8192, 16384, 32768]).astype(np.float32)
+    capacity = np.zeros((pn, 4), dtype=np.float32)
+    capacity[:n_nodes, 0] = cpu
+    capacity[:n_nodes, 1] = mem
+    capacity[:n_nodes, 2] = 100 * 1024
+    capacity[:n_nodes, 3] = 1000
+    used = np.zeros_like(capacity)
+    # pre-existing load: 0-40% of cpu/mem
+    load = rng.uniform(0.0, 0.4, size=(n_nodes, 1)).astype(np.float32)
+    used[:n_nodes, :2] = capacity[:n_nodes, :2] * load
+    ready = np.zeros(pn, dtype=bool)
+    ready[:n_nodes] = True
+    return ClusterTensors(
+        node_ids=[f"node-{i}" for i in range(n_nodes)],
+        index=1,
+        num_nodes=n_nodes,
+        capacity=capacity,
+        used=used,
+        ready=ready,
+        dc_ids=np.pad(rng.integers(0, 3, n_nodes).astype(np.int32), (0, pn - n_nodes)),
+        class_ids=np.pad(classes.astype(np.int32), (0, pn - n_nodes)),
+        dc_vocab={"dc1": 0, "dc2": 1, "dc3": 2},
+        class_vocab={"small": 0, "medium": 1, "large": 2},
+        class_rep=[0, 1, 2],
+        node_row={f"node-{i}": i for i in range(n_nodes)},
+    )
+
+
+def build_asks(ct, n_jobs: int, count_per_job: int, seed: int = 7):
+    from nomad_tpu.device.flatten import GroupAsk
+
+    rng = np.random.default_rng(seed)
+    pn = ct.padded_n
+    asks = []
+    for j in range(n_jobs):
+        cpu = float(rng.choice([250, 500, 1000]))
+        mem = float(rng.choice([256, 512, 1024]))
+        asks.append(
+            GroupAsk(
+                job_id=f"job-{j}",
+                tg_name="web",
+                count=count_per_job,
+                desired_total=count_per_job,
+                ask=np.array([cpu, mem, 300.0, 0.0], dtype=np.float32),
+                eligible=ct.ready.copy(),
+                job_counts=np.zeros(pn, dtype=np.int32),
+                penalty_nodes=np.zeros(pn, dtype=bool),
+                affinity_scores=np.zeros(pn, dtype=np.float32),
+                has_affinities=False,
+                distinct_hosts=False,
+                spread_value_ids=np.full(pn, -1, dtype=np.int32),
+                spread_desired=np.zeros(1, dtype=np.float32),
+                spread_initial_counts=np.zeros(1, dtype=np.float32),
+                spread_weight=0.0,
+                has_spreads=False,
+                num_spread_values=1,
+            )
+        )
+    return asks
+
+
+def main():
+    n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    n_jobs = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    count = int(sys.argv[3]) if len(sys.argv) > 3 else 1_000
+
+    _ensure_live_backend()
+    import jax
+
+    from nomad_tpu.device.score import PlacementKernel
+
+    ct = build_cluster(n_nodes)
+    asks = build_asks(ct, n_jobs, count)
+    kernel = PlacementKernel("binpack")
+
+    # warmup: compile the shape bucket
+    kernel.place(ct, asks)
+
+    t0 = time.perf_counter()
+    results = kernel.place(ct, asks)
+    elapsed = time.perf_counter() - t0
+
+    placed = sum(int((r.node_rows >= 0).sum()) for r in results)
+    total = n_jobs * count
+    allocs_per_sec = placed / elapsed if elapsed > 0 else 0.0
+    per_chip_target = 100_000 / 8.0  # north-star share for one v5e chip
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"allocs planned/sec ({n_jobs} jobs x {count} allocs vs "
+                    f"{n_nodes} nodes, binpack, {jax.devices()[0].platform})"
+                ),
+                "value": round(allocs_per_sec, 1),
+                "unit": "allocs/s",
+                "vs_baseline": round(allocs_per_sec / per_chip_target, 3),
+                "detail": {
+                    "placed": placed,
+                    "total": total,
+                    "elapsed_s": round(elapsed, 4),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""Leader-side queues and the serialized plan applier."""
+
+from .plan_apply import PlanApplier, evaluate_node_plan, evaluate_plan
+
+__all__ = ["PlanApplier", "evaluate_plan", "evaluate_node_plan"]

@@ -1,0 +1,257 @@
+"""EvalBroker — leader-side at-least-once priority queue of evaluations.
+
+Reference: nomad/eval_broker.go (:47-105 EvalBroker, :182 Enqueue, blocking
+Dequeue with per-scheduler-type ready queues, Ack/Nack with unack tracking,
+nack redelivery with delay, DeliveryLimit → _failed queue, delayheap for
+WaitUntil evals, per-job serialization: at most one eval per job in flight,
+later ones deferred until the outstanding one is acked).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+import uuid
+from typing import Optional
+
+from ..structs import Evaluation
+from ..structs.evaluation import EVAL_DELIVERY_LIMIT
+
+FAILED_QUEUE = "_failed"
+DEFAULT_NACK_DELAY = 5.0
+DEFAULT_INITIAL_NACK_DELAY = 1.0
+
+
+class _PQ:
+    """Priority queue: higher eval priority first, FIFO within priority."""
+
+    def __init__(self):
+        self._h: list[tuple] = []
+        self._c = itertools.count()
+
+    def push(self, ev: Evaluation) -> None:
+        heapq.heappush(self._h, (-ev.priority, next(self._c), ev))
+
+    def pop(self) -> Optional[Evaluation]:
+        if not self._h:
+            return None
+        return heapq.heappop(self._h)[2]
+
+    def peek(self) -> Optional[Evaluation]:
+        return self._h[0][2] if self._h else None
+
+    def __len__(self):
+        return len(self._h)
+
+
+class EvalBroker:
+    def __init__(
+        self,
+        nack_delay: float = DEFAULT_NACK_DELAY,
+        initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
+        delivery_limit: int = EVAL_DELIVERY_LIMIT,
+    ):
+        self._lock = threading.Condition()
+        self.enabled = False
+        self.nack_delay = nack_delay
+        self.initial_nack_delay = initial_nack_delay
+        self.delivery_limit = delivery_limit
+        # scheduler type → ready queue
+        self._ready: dict[str, _PQ] = {}
+        # eval id → (eval, token, deadline) while unacked
+        self._unack: dict[str, tuple[Evaluation, str]] = {}
+        # (ns, job id) → deferred evals waiting for the in-flight one
+        self._pending_by_job: dict[tuple[str, str], _PQ] = {}
+        self._in_flight_jobs: set[tuple[str, str]] = set()
+        # delayed: (fire_time, seq, eval, type) heap for WaitUntil + nacks
+        self._delayed: list[tuple] = []
+        self._seq = itertools.count()
+        self._delivery_count: dict[str, int] = {}
+        self.stats = {
+            "total_ready": 0,
+            "total_unacked": 0,
+            "total_blocked_on_job": 0,
+            "total_waiting": 0,
+            "total_failed": 0,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self.enabled = enabled
+            if not enabled:
+                self._ready.clear()
+                self._unack.clear()
+                self._pending_by_job.clear()
+                self._in_flight_jobs.clear()
+                self._delayed.clear()
+                self._delivery_count.clear()
+            self._lock.notify_all()
+
+    # -- enqueue -----------------------------------------------------------
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._enqueue_locked(ev)
+            self._lock.notify_all()
+
+    def enqueue_all(self, evals: list[Evaluation]) -> None:
+        with self._lock:
+            for ev in evals:
+                self._enqueue_locked(ev)
+            self._lock.notify_all()
+
+    def _enqueue_locked(self, ev: Evaluation, ignore_job_gate: bool = False) -> None:
+        if not self.enabled:
+            return
+        now = time.time()
+        if ev.wait_until_unix and ev.wait_until_unix > now:
+            heapq.heappush(
+                self._delayed, (ev.wait_until_unix, next(self._seq), ev)
+            )
+            return
+        job_key = (ev.namespace, ev.job_id)
+        if not ignore_job_gate and job_key in self._in_flight_jobs:
+            self._pending_by_job.setdefault(job_key, _PQ()).push(ev)
+            return
+        self._ready.setdefault(ev.type, _PQ()).push(ev)
+
+    def _drain_delayed_locked(self) -> float:
+        """Move due delayed evals to ready; return seconds to next firing."""
+        now = time.time()
+        wait = 3600.0
+        while self._delayed:
+            fire, _, ev = self._delayed[0]
+            if fire <= now:
+                heapq.heappop(self._delayed)
+                ev2 = ev
+                ev2.wait_until_unix = 0.0
+                self._enqueue_locked(ev2)
+            else:
+                wait = fire - now
+                break
+        return wait
+
+    # -- dequeue -----------------------------------------------------------
+    def dequeue(
+        self, schedulers: list[str], timeout: float = 0.0
+    ) -> tuple[Optional[Evaluation], str]:
+        """Blocking dequeue for the given scheduler types. Returns
+        (eval, token) or (None, "") on timeout/disable."""
+        deadline = time.time() + timeout if timeout else None
+        with self._lock:
+            while True:
+                if not self.enabled:
+                    return None, ""
+                next_delay = self._drain_delayed_locked()
+                best: Optional[_PQ] = None
+                for t in schedulers:
+                    q = self._ready.get(t)
+                    if not q:
+                        continue
+                    # defer ready evals whose job already has one in flight
+                    # (per-job serialization also applies to evals enqueued
+                    # before the first one was dequeued)
+                    while len(q):
+                        cand = q.peek()
+                        job_key = (cand.namespace, cand.job_id)
+                        if job_key in self._in_flight_jobs:
+                            q.pop()
+                            self._pending_by_job.setdefault(job_key, _PQ()).push(
+                                cand
+                            )
+                            continue
+                        break
+                    if len(q):
+                        cand = q.peek()
+                        if best is None or cand.priority > best.peek().priority:
+                            best = q
+                if best is not None:
+                    ev = best.pop()
+                    token = str(uuid.uuid4())
+                    self._unack[ev.id] = (ev, token)
+                    self._in_flight_jobs.add((ev.namespace, ev.job_id))
+                    self._delivery_count[ev.id] = (
+                        self._delivery_count.get(ev.id, 0) + 1
+                    )
+                    return ev, token
+                if deadline is None:
+                    self._lock.wait(min(next_delay, 1.0))
+                else:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return None, ""
+                    self._lock.wait(min(remaining, next_delay, 1.0))
+
+    # -- ack / nack --------------------------------------------------------
+    def _validate(self, eval_id: str, token: str) -> Evaluation:
+        entry = self._unack.get(eval_id)
+        if entry is None:
+            raise ValueError(f"eval {eval_id} not outstanding")
+        ev, tok = entry
+        if tok != token:
+            raise ValueError("token mismatch")
+        return ev
+
+    def _promote_pending_locked(self, job_key: tuple[str, str]) -> None:
+        """Release the next deferred eval for a job whose gate opened."""
+        pq = self._pending_by_job.get(job_key)
+        if pq is not None and len(pq):
+            nxt = pq.pop()
+            if not len(pq):
+                del self._pending_by_job[job_key]
+            self._enqueue_locked(nxt)
+
+    def ack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            ev = self._validate(eval_id, token)
+            del self._unack[eval_id]
+            self._delivery_count.pop(eval_id, None)
+            job_key = (ev.namespace, ev.job_id)
+            self._in_flight_jobs.discard(job_key)
+            self._promote_pending_locked(job_key)
+            self._lock.notify_all()
+
+    def nack(self, eval_id: str, token: str) -> None:
+        """Failed processing: redeliver after a backoff, unless the
+        delivery limit is reached — then route to the _failed queue."""
+        with self._lock:
+            ev = self._validate(eval_id, token)
+            del self._unack[eval_id]
+            job_key = (ev.namespace, ev.job_id)
+            self._in_flight_jobs.discard(job_key)
+            count = self._delivery_count.get(ev.id, 0)
+            if count >= self.delivery_limit:
+                self._ready.setdefault(FAILED_QUEUE, _PQ()).push(ev)
+                # the job's gate is permanently released for this eval —
+                # deferred evals must not be stranded behind it
+                self._promote_pending_locked(job_key)
+            else:
+                delay = (
+                    self.initial_nack_delay if count <= 1 else self.nack_delay
+                )
+                heapq.heappush(
+                    self._delayed,
+                    (time.time() + delay, next(self._seq), ev),
+                )
+            self._lock.notify_all()
+
+    # -- introspection -----------------------------------------------------
+    def outstanding(self, eval_id: str) -> bool:
+        with self._lock:
+            return eval_id in self._unack
+
+    def outstanding_token(self, eval_id: str) -> str:
+        with self._lock:
+            entry = self._unack.get(eval_id)
+            return entry[1] if entry else ""
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(len(q) for t, q in self._ready.items() if t != FAILED_QUEUE)
+
+    def failed_count(self) -> int:
+        with self._lock:
+            q = self._ready.get(FAILED_QUEUE)
+            return len(q) if q else 0

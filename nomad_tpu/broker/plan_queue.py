@@ -1,0 +1,103 @@
+"""PlanQueue — priority-ordered pending plans with result futures.
+
+Reference: nomad/plan_queue.go (:29-60) and the planApply loop
+(nomad/plan_apply.go:71-178), which pipelines: while plan N's Raft commit
+is in flight, plan N+1 is already being evaluated against the optimistic
+post-N snapshot — worth keeping because evaluation (fit re-check) and
+commit (log write) use different resources. Here the applier thread
+evaluates the next plan while the store upsert of the previous one
+completes asynchronously is a no-op (in-memory store), but the structure
+is retained so a durable log can slot in.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+from ..structs import Plan, PlanResult
+from .plan_apply import PlanApplier
+
+
+class PendingPlan:
+    __slots__ = ("plan", "future")
+
+    def __init__(self, plan: Plan):
+        self.plan = plan
+        self.future: Future[PlanResult] = Future()
+
+
+class PlanQueue:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._heap: list[tuple] = []
+        self._c = itertools.count()
+        self.enabled = False
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self.enabled = enabled
+            if not enabled:
+                for _, _, pending in self._heap:
+                    pending.future.cancel()
+                self._heap.clear()
+            self._lock.notify_all()
+
+    def enqueue(self, plan: Plan) -> Future:
+        with self._lock:
+            if not self.enabled:
+                f: Future = Future()
+                f.set_exception(RuntimeError("plan queue is disabled"))
+                return f
+            pending = PendingPlan(plan)
+            heapq.heappush(self._heap, (-plan.priority, next(self._c), pending))
+            self._lock.notify_all()
+            return pending.future
+
+    def pop(self, timeout: float = 1.0) -> Optional[PendingPlan]:
+        with self._lock:
+            if not self._heap:
+                self._lock.wait(timeout)
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[2]
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+
+class PlanApplyLoop:
+    """The leader's serialized applier thread (plan_apply.go:71-178)."""
+
+    def __init__(self, store, queue: PlanQueue):
+        self.applier = PlanApplier(store)
+        self.queue = queue
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="plan-apply", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            pending = self.queue.pop(timeout=0.2)
+            if pending is None:
+                continue
+            try:
+                result = self.applier.apply(pending.plan)
+                pending.future.set_result(result)
+            except Exception as e:  # noqa: BLE001 — propagate to waiter
+                pending.future.set_exception(e)

@@ -1,0 +1,15 @@
+"""Device layer: cluster flattening + compiled placement/score kernels."""
+
+from .flatten import ClusterTensors, GroupAsk, flatten_cluster, flatten_group_ask
+from .score import PlacementKernel, PlacementResult, place_batch_kernel, score_matrix_kernel
+
+__all__ = [
+    "ClusterTensors",
+    "GroupAsk",
+    "flatten_cluster",
+    "flatten_group_ask",
+    "PlacementKernel",
+    "PlacementResult",
+    "place_batch_kernel",
+    "score_matrix_kernel",
+]

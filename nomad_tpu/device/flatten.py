@@ -1,0 +1,354 @@
+"""Flattening layer: snapshot state → dense device tensors.
+
+This is the layer SURVEY.md §7 step 1 demands: `NodeResources`/`Resources`
+→ dense ``float32[nodes, dims]`` arrays with a stable node-index mapping
+and masks for datacenter/class/eligibility. The reference walks Go structs
+per node per placement (scheduler/rank.go:193-527); we pay the struct walk
+once per snapshot refresh and let every placement reuse the arrays.
+
+Split of labor (mirrors the reference's class-memoization bet,
+scheduler/feasible.go:1029-1153: classes ≪ nodes):
+
+- **Host (here):** resolve string/regex/version constraints once per
+  *computed node class* into per-class bits, then broadcast to per-node
+  masks with one gather. Constraints touching ``unique.`` attributes are
+  evaluated per node ("escaped class" in the reference's terms).
+- **Device (score.py):** resource fit, scoring, argmax, and the greedy
+  placement scan over dense arrays only.
+
+Shapes are padded to buckets (powers of two) so XLA compiles a handful of
+program shapes regardless of node churn.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..structs import NUM_DIMS, Job, TaskGroup
+from ..structs.resources import node_comparable_capacity
+
+
+def _check_constraint(node, c):
+    # deferred import: scheduler package imports device at init time, so a
+    # top-level import here would be circular
+    from ..scheduler.feasible import check_constraint
+
+    return check_constraint(node, c)
+
+# Padding buckets for the node axis: next power of two, min 8. Keeps the
+# number of distinct compiled shapes logarithmic in cluster size.
+_MIN_BUCKET = 8
+
+
+def node_bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+@dataclass
+class ClusterTensors:
+    """Dense snapshot of schedulable cluster state.
+
+    ``node_ids[i]`` ↔ row i of every array; rows ≥ ``num_nodes`` are
+    padding (``ready`` False ⇒ never selected).
+    """
+
+    node_ids: list[str]
+    index: int  # state index this was built at (raft watermark analog)
+    num_nodes: int
+    capacity: np.ndarray  # f32[N, D] reserved-adjusted capacity
+    used: np.ndarray  # f32[N, D] non-terminal alloc usage
+    ready: np.ndarray  # bool[N]
+    dc_ids: np.ndarray  # i32[N]
+    class_ids: np.ndarray  # i32[N]
+    dc_vocab: dict[str, int]
+    class_vocab: dict[str, int]
+    # per-class representative node index (for host-side class evaluation)
+    class_rep: list[int]
+    node_row: dict[str, int] = field(default_factory=dict)
+
+    @property
+    def padded_n(self) -> int:
+        return self.capacity.shape[0]
+
+    def row_of(self, node_id: str) -> int:
+        return self.node_row[node_id]
+
+
+def flatten_cluster(snap, nodes=None) -> ClusterTensors:
+    """Build ClusterTensors from a StateSnapshot (or an explicit node list).
+
+    Usage is summed from each node's non-terminal allocations — the same
+    quantity ``BinPackIterator`` derives per node via ProposedAllocs
+    (scheduler/context.go:120-157), minus in-flight plan deltas which the
+    scheduler overlays separately (see score.py's ``used`` argument).
+    """
+    if nodes is None:
+        nodes = sorted(snap.nodes(), key=lambda n: n.id)
+    else:
+        nodes = sorted(nodes, key=lambda n: n.id)
+    n = len(nodes)
+    pn = node_bucket(max(n, 1))
+
+    capacity = np.zeros((pn, NUM_DIMS), dtype=np.float32)
+    used = np.zeros((pn, NUM_DIMS), dtype=np.float32)
+    ready = np.zeros(pn, dtype=bool)
+    dc_ids = np.zeros(pn, dtype=np.int32)
+    class_ids = np.zeros(pn, dtype=np.int32)
+    dc_vocab: dict[str, int] = {}
+    class_vocab: dict[str, int] = {}
+    class_rep: list[int] = []
+    node_row: dict[str, int] = {}
+
+    for i, node in enumerate(nodes):
+        node_row[node.id] = i
+        capacity[i] = node_comparable_capacity(node).to_vector()
+        ready[i] = node.ready()
+        dc_ids[i] = dc_vocab.setdefault(node.datacenter, len(dc_vocab))
+        if not node.computed_class:
+            node.compute_class()
+        cid = class_vocab.setdefault(node.computed_class, len(class_vocab))
+        if cid == len(class_rep):
+            class_rep.append(i)
+        class_ids[i] = cid
+        if snap is not None:
+            for a in snap.allocs_by_node(node.id):
+                if not a.terminal_status():
+                    used[i] += a.comparable_resources().to_vector()
+
+    return ClusterTensors(
+        node_ids=[nd.id for nd in nodes],
+        index=getattr(snap, "index", 0) if snap is not None else 0,
+        num_nodes=n,
+        capacity=capacity,
+        used=used,
+        ready=ready,
+        dc_ids=dc_ids,
+        class_ids=class_ids,
+        dc_vocab=dc_vocab,
+        class_vocab=class_vocab,
+        class_rep=class_rep,
+        node_row=node_row,
+    )
+
+
+@dataclass
+class GroupAsk:
+    """One task group's flattened placement request — everything the device
+    kernel needs, with strings already resolved to masks/ids."""
+
+    job_id: str
+    tg_name: str
+    count: int  # placements wanted in this pass
+    desired_total: int  # tg.count — anti-affinity denominator (rank.go:589)
+    ask: np.ndarray  # f32[D]
+    eligible: np.ndarray  # bool[N] constraint ∧ dc ∧ ready mask
+    job_counts: np.ndarray  # i32[N] existing allocs of this job per node
+    penalty_nodes: np.ndarray  # bool[N] rescheduling penalty (rank.go:606)
+    affinity_scores: np.ndarray  # f32[N] pre-normalized [-1, 1]
+    has_affinities: bool
+    distinct_hosts: bool
+    # spread: node → value-id of the (single merged) spread attribute;
+    # -1 where the node has no value. Multiple spread blocks are summed
+    # host-side into one per-node boost-rate pair (see spread_* below).
+    spread_value_ids: np.ndarray  # i32[N]
+    spread_desired: np.ndarray  # f32[V] desired count per value id
+    spread_initial_counts: np.ndarray  # f32[V] existing usage per value id
+    spread_weight: float
+    has_spreads: bool
+    num_spread_values: int
+
+
+def _eligibility_for_group(
+    ct: ClusterTensors, nodes_sorted, job: Job, tg: TaskGroup
+) -> np.ndarray:
+    """ready ∧ datacenter ∧ hard constraints, with per-class memoization.
+
+    Constraints whose targets resolve per-node (``unique.`` attrs, node id/
+    name) force per-node evaluation — the "escaped computed class" path
+    (scheduler/feasible.go:1029-1153)."""
+    pn = ct.padded_n
+    eligible = ct.ready.copy()
+
+    dc_ok = np.zeros(pn, dtype=bool)
+    for dc in job.datacenters:
+        cid = ct.dc_vocab.get(dc)
+        if cid is not None:
+            dc_ok |= ct.dc_ids == cid
+    eligible &= dc_ok
+
+    constraints = job.constraints_for_group(tg)
+    # implicit driver constraints: every task's driver must be healthy
+    drivers = {t.driver for t in tg.tasks}
+
+    escaped = any(
+        "unique." in c.l_target or "unique." in c.r_target for c in constraints
+    )
+    if escaped or not constraints and not drivers:
+        rows = range(ct.num_nodes)
+        per_class = False
+    else:
+        rows = ct.class_rep
+        per_class = True
+
+    ok_rows = np.ones(len(ct.class_rep) if per_class else ct.num_nodes, dtype=bool)
+    for j, i in enumerate(rows):
+        node = nodes_sorted[i]
+        for d in drivers:
+            if not node.drivers.get(d, False):
+                ok_rows[j] = False
+                break
+        if ok_rows[j]:
+            for c in constraints:
+                if c.operand in ("distinct_hosts", "distinct_property"):
+                    continue  # handled dynamically / via property sets
+                if not _check_constraint(node, c):
+                    ok_rows[j] = False
+                    break
+    if per_class:
+        class_ok = ok_rows
+        eligible[: ct.num_nodes] &= class_ok[ct.class_ids[: ct.num_nodes]]
+    else:
+        eligible[: ct.num_nodes] &= ok_rows
+    return eligible
+
+
+def _affinity_scores(ct, nodes_sorted, job: Job, tg: TaskGroup) -> tuple[np.ndarray, bool]:
+    """Weight-normalized affinity score per node, in [-1, 1]
+    (scheduler/rank.go:650-737: Σ w_i·match_i / Σ|w_i|)."""
+    affs = job.affinities_for_group(tg)
+    scores = np.zeros(ct.padded_n, dtype=np.float32)
+    if not affs:
+        return scores, False
+    total = float(sum(abs(a.weight) for a in affs)) or 1.0
+    for a in affs:
+        from ..structs import Constraint
+
+        c = Constraint(l_target=a.l_target, r_target=a.r_target, operand=a.operand)
+        for i in range(ct.num_nodes):
+            if _check_constraint(nodes_sorted[i], c):
+                scores[i] += a.weight
+    return scores / total, True
+
+
+def _spread_tensors(ct, nodes_sorted, job: Job, tg: TaskGroup, snap, total_desired):
+    """Merge the group's spread blocks into per-node value ids + per-value
+    desired counts (scheduler/spread.go:110-257). With explicit targets the
+    desired count is percent×total; without, even spread over seen values."""
+    spreads = job.spreads_for_group(tg)
+    pn = ct.padded_n
+    if not spreads:
+        return (
+            np.full(pn, -1, dtype=np.int32),
+            np.zeros(1, dtype=np.float32),
+            np.zeros(1, dtype=np.float32),
+            0.0,
+            False,
+            1,
+        )
+    # Round 1: support one spread attribute (merged weight); multi-block
+    # spreads are scored against the first block. TODO(round2): stack
+    # value-id planes per block and sum boosts in-kernel.
+    sp = spreads[0]
+    value_ids: dict[str, int] = {}
+    node_vals = np.full(pn, -1, dtype=np.int32)
+    for i in range(ct.num_nodes):
+        v = nodes_sorted[i].lookup_attribute(sp.attribute)
+        if v is not None:
+            node_vals[i] = value_ids.setdefault(v, len(value_ids))
+    nv = max(len(value_ids), 1)
+    desired = np.zeros(nv, dtype=np.float32)
+    if sp.targets:
+        for t in sp.targets:
+            vid = value_ids.get(t.value)
+            if vid is not None:
+                desired[vid] = np.ceil(t.percent / 100.0 * total_desired)
+    else:
+        desired[:] = np.ceil(total_desired / nv)
+    counts = np.zeros(nv, dtype=np.float32)
+    if snap is not None:
+        for a in snap.allocs_by_job(job.namespace, job.id):
+            if a.terminal_status() or a.task_group != tg.name:
+                continue
+            row = ct.node_row.get(a.node_id)
+            if row is not None and node_vals[row] >= 0:
+                counts[node_vals[row]] += 1
+    weight = float(sp.weight) / 100.0
+    return node_vals, desired, counts, weight, True, nv
+
+
+def flatten_group_ask(
+    ct: ClusterTensors,
+    snap,
+    job: Job,
+    tg: TaskGroup,
+    count: int,
+    *,
+    nodes_sorted=None,
+    penalty_node_ids: set[str] | None = None,
+) -> GroupAsk:
+    """Flatten one (job, task group, count) placement request."""
+    if nodes_sorted is None:
+        nodes_sorted = (
+            sorted(snap.nodes(), key=lambda n: n.id) if snap is not None else []
+        )
+    ask_res = tg.combined_resources()
+    ask = np.array(
+        [
+            ask_res.cpu,
+            ask_res.memory_mb,
+            ask_res.disk_mb,
+            ask_res.bandwidth_mbits(),
+        ],
+        dtype=np.float32,
+    )
+
+    eligible = _eligibility_for_group(ct, nodes_sorted, job, tg)
+
+    job_counts = np.zeros(ct.padded_n, dtype=np.int32)
+    if snap is not None:
+        for a in snap.allocs_by_job(job.namespace, job.id):
+            if a.terminal_status():
+                continue
+            row = ct.node_row.get(a.node_id)
+            if row is not None:
+                job_counts[row] += 1
+
+    penalty = np.zeros(ct.padded_n, dtype=bool)
+    for nid in penalty_node_ids or ():
+        row = ct.node_row.get(nid)
+        if row is not None:
+            penalty[row] = True
+
+    aff, has_aff = _affinity_scores(ct, nodes_sorted, job, tg)
+    sp_vals, sp_desired, sp_counts, sp_w, has_sp, nv = _spread_tensors(
+        ct, nodes_sorted, job, tg, snap, tg.count
+    )
+
+    distinct = any(
+        c.operand == "distinct_hosts" for c in job.constraints_for_group(tg)
+    )
+
+    return GroupAsk(
+        job_id=job.id,
+        tg_name=tg.name,
+        count=count,
+        desired_total=max(tg.count, 1),
+        ask=ask,
+        eligible=eligible,
+        job_counts=job_counts,
+        penalty_nodes=penalty,
+        affinity_scores=aff,
+        has_affinities=has_aff,
+        distinct_hosts=distinct,
+        spread_value_ids=sp_vals,
+        spread_desired=sp_desired,
+        spread_initial_counts=sp_counts,
+        spread_weight=sp_w,
+        has_spreads=has_sp,
+        num_spread_values=nv,
+    )

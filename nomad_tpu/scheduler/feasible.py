@@ -1,0 +1,158 @@
+"""Host-side hard-constraint evaluation.
+
+Reference: scheduler/feasible.go — resolveTarget (:748-781) and
+checkConstraint's operator dispatch (:785-820) with the full operand set
+(=, !=, <, <=, >, >=, regexp, version, semver, set_contains*, is_set).
+
+In the TPU design this code runs **once per computed node class** (or per
+node for constraints touching ``unique.`` attributes), producing boolean
+masks that ``device.flatten`` broadcasts into the dense eligibility tensor.
+Regex and version parsing never reach the device — the same "classes ≪
+nodes" bet the reference makes with its class memoization
+(feasible.go:1029-1153).
+"""
+
+from __future__ import annotations
+
+import re
+from functools import lru_cache
+from typing import Optional
+
+from ..structs import Constraint
+from ..structs.node import Node
+
+
+@lru_cache(maxsize=1024)
+def _compiled_regex(pattern: str):
+    try:
+        return re.compile(pattern)
+    except re.error:
+        return None
+
+
+@lru_cache(maxsize=4096)
+def _parse_version(v: str) -> Optional[tuple]:
+    """Lenient version parse: dotted numerics with optional prerelease tag
+    ("1.2.3-beta2" < "1.2.3"). Mirrors go-version's ordering closely enough
+    for constraint checking."""
+    v = v.strip().lstrip("v")
+    if not v:
+        return None
+    main, _, pre = v.partition("-")
+    parts = []
+    for p in main.split("."):
+        if not p.isdigit():
+            return None
+        parts.append(int(p))
+    while len(parts) < 3:
+        parts.append(0)
+    # releases sort after prereleases of the same version
+    return (tuple(parts), 1 if not pre else 0, pre)
+
+
+def _check_version_constraint(lval: str, constraint_expr: str, lenient: bool) -> bool:
+    """Version constraint like ">= 1.2, < 2.0" (go-version syntax).
+    ``lenient`` mode (operand "version") tolerates non-semver lvals;
+    strict mode ("semver") requires a clean parse."""
+    lv = _parse_version(lval)
+    if lv is None:
+        return False
+    for clause in constraint_expr.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        m = re.match(r"^(>=|<=|!=|><|[=<>~]+)?\s*(.+)$", clause)
+        if not m:
+            return False
+        op = m.group(1) or "="
+        rv = _parse_version(m.group(2))
+        if rv is None:
+            return False
+        if op in ("=", "=="):
+            ok = lv == rv
+        elif op == "!=":
+            ok = lv != rv
+        elif op == ">":
+            ok = lv > rv
+        elif op == ">=":
+            ok = lv >= rv
+        elif op == "<":
+            ok = lv < rv
+        elif op == "<=":
+            ok = lv <= rv
+        elif op in ("~>",):
+            # pessimistic: >= rv and < next significant release
+            lo = rv[0]
+            hi = list(lo[:-1])
+            if len(hi) > 0:
+                hi[-1] += 1
+            ok = lv >= rv and lv[0] < tuple(hi) + (0,) * (3 - len(hi))
+        else:
+            ok = False
+        if not ok:
+            return False
+    return True
+
+
+def _lexical_or_numeric_cmp(l: str, r: str) -> Optional[int]:
+    """Order comparison: numeric when both parse, else lexical
+    (feasible.go checkLexicalOrder / checkOrder)."""
+    try:
+        lf, rf = float(l), float(r)
+        return (lf > rf) - (lf < rf)
+    except ValueError:
+        return (l > r) - (l < r)
+
+
+def check_constraint_values(operand: str, lval: Optional[str], rval: str) -> bool:
+    """Operator dispatch on already-resolved values."""
+    if operand == "is_set":
+        return lval is not None
+    if operand == "is_not_set":
+        return lval is None
+    if lval is None:
+        return False
+    if operand in ("=", "==", "is"):
+        return lval == rval
+    if operand in ("!=", "not"):
+        return lval != rval
+    if operand in ("<", "<=", ">", ">="):
+        c = _lexical_or_numeric_cmp(lval, rval)
+        if c is None:
+            return False
+        return {
+            "<": c < 0,
+            "<=": c <= 0,
+            ">": c > 0,
+            ">=": c >= 0,
+        }[operand]
+    if operand == "regexp":
+        rx = _compiled_regex(rval)
+        return rx is not None and rx.search(lval) is not None
+    if operand == "version":
+        return _check_version_constraint(lval, rval, lenient=True)
+    if operand == "semver":
+        return _check_version_constraint(lval, rval, lenient=False)
+    if operand in ("set_contains", "set_contains_all"):
+        have = {p.strip() for p in lval.split(",")}
+        want = {p.strip() for p in rval.split(",")}
+        return want <= have
+    if operand == "set_contains_any":
+        have = {p.strip() for p in lval.split(",")}
+        want = {p.strip() for p in rval.split(",")}
+        return bool(want & have)
+    return False
+
+
+def check_constraint(node: Node, c: Constraint) -> bool:
+    """Resolve targets against the node, then dispatch. Both sides may be
+    interpolations (feasible.go resolveTarget): a bare RTarget is a
+    literal; an ${...} RTarget resolves against the node too."""
+    lval = node.lookup_attribute(c.l_target) if c.l_target else None
+    rval = c.r_target
+    if rval.startswith("${") and rval.endswith("}"):
+        resolved = node.lookup_attribute(rval)
+        if resolved is None and c.operand not in ("is_set", "is_not_set"):
+            return False
+        rval = resolved if resolved is not None else ""
+    return check_constraint_values(c.operand, lval, rval)

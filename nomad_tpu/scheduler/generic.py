@@ -1,0 +1,345 @@
+"""GenericScheduler — service and batch job scheduling with the TPU
+placement backend.
+
+Reference control flow: scheduler/generic_sched.go — Process (:125) retry
+loop, process (:216), computeJobAllocs (:332), computePlacements (:472),
+blocked-eval creation (:193-212), attempt limits (:15-22: 5 service /
+2 batch). The per-placement iterator walk the reference does inside
+computePlacements is replaced wholesale by one batched device kernel call
+per (job, task group): flatten → greedy placement scan on device → build
+allocations from the chosen rows (SURVEY.md §7 steps 3+5).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..device import PlacementKernel, flatten_cluster, flatten_group_ask
+from ..structs import (
+    ALLOC_DESIRED_RUN,
+    EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
+    Allocation,
+    AllocMetric,
+    ComparableResources,
+    Evaluation,
+    Plan,
+    TRIGGER_MAX_PLANS,
+    new_id,
+)
+from ..structs.evaluation import (
+    EVAL_STATUS_BLOCKED,
+    TRIGGER_JOB_REGISTER,
+    TRIGGER_QUEUED_ALLOCS,
+)
+from .reconcile import reconcile
+from .scheduler import Planner, register_scheduler
+
+MAX_SERVICE_SCHEDULE_ATTEMPTS = 5  # generic_sched.go:15-18
+MAX_BATCH_SCHEDULE_ATTEMPTS = 2  # generic_sched.go:19-22
+
+BLOCKED_EVAL_MAX_PLAN_DESC = "created due to placement conflicts"
+BLOCKED_EVAL_FAILED_PLACEMENTS_DESC = "created to place remaining allocations"
+
+
+class FailedTGAlloc:
+    """Per-group placement-failure metrics attached to the eval
+    (structs.AllocMetric in Evaluation.FailedTGAllocs)."""
+
+    def __init__(self, metric: AllocMetric):
+        self.metric = metric
+
+
+def tainted_nodes(snapshot, allocs) -> dict:
+    """Map node id → Node for nodes that are down or draining
+    (scheduler/util.go:354-378). Nodes missing from state count as tainted
+    (down)."""
+    out = {}
+    for a in allocs:
+        if a.node_id in out:
+            continue
+        node = snapshot.node_by_id(a.node_id)
+        if node is None:
+            from ..structs import Node, NODE_STATUS_DOWN
+
+            out[a.node_id] = Node(id=a.node_id, status=NODE_STATUS_DOWN)
+        elif node.terminal_status() or node.drain is not None or not node.ready():
+            if node.status != "initializing":
+                out[a.node_id] = node
+    return out
+
+
+@register_scheduler("service")
+@register_scheduler("batch")
+class GenericScheduler:
+    def __init__(self, snapshot, planner: Planner, *, batch: bool = False):
+        self.snapshot = snapshot
+        self.planner = planner
+        self.batch = batch
+        self.kernel: Optional[PlacementKernel] = None
+        self.eval: Optional[Evaluation] = None
+        self.job = None
+        self.plan: Optional[Plan] = None
+        self.failed_tg_allocs: dict[str, AllocMetric] = {}
+        self.queued_allocs: dict[str, int] = {}
+        self.followup_evals: list[Evaluation] = []
+        self.blocked: Optional[Evaluation] = None
+
+    # -- entry point ------------------------------------------------------
+    def process(self, evaluation: Evaluation) -> None:
+        """Retry loop (generic_sched.go:125-214)."""
+        self.eval = evaluation
+        self.batch = self.batch or evaluation.type == "batch"
+        limit = (
+            MAX_BATCH_SCHEDULE_ATTEMPTS
+            if self.batch
+            else MAX_SERVICE_SCHEDULE_ATTEMPTS
+        )
+        cfg = self.snapshot.scheduler_config()
+        self.kernel = PlacementKernel(cfg.scheduler_algorithm)
+
+        success = False
+        for _attempt in range(limit):
+            done, reschedule = self._process_once()
+            if done:
+                success = True
+                break
+            if not reschedule:
+                break
+        if not success and not self._finished:
+            # max plan attempts: mark failed, roll a new blocked eval so the
+            # job eventually converges (generic_sched.go:156-193)
+            self._set_status(EVAL_STATUS_FAILED, "maximum attempts reached")
+            blocked = evaluation.create_blocked_eval({}, True, "", {})
+            blocked.triggered_by = TRIGGER_MAX_PLANS
+            blocked.status_description = BLOCKED_EVAL_MAX_PLAN_DESC
+            self.planner.create_eval(blocked)
+            return
+        self._finalize()
+
+    _finished = False
+
+    # -- one attempt ------------------------------------------------------
+    def _process_once(self) -> tuple[bool, bool]:
+        """Returns (done, should_retry)."""
+        ev = self.eval
+        self.failed_tg_allocs = {}
+        self.followup_evals = []
+        self.job = self.snapshot.job_by_id(ev.namespace, ev.job_id)
+        self.plan = ev.make_plan(self.job)
+        self.plan.snapshot_index = getattr(self.snapshot, "index", 0)
+
+        existing = self.snapshot.allocs_by_job(ev.namespace, ev.job_id)
+        tainted = tainted_nodes(self.snapshot, existing)
+        results = reconcile(
+            self.job,
+            ev.job_id,
+            existing,
+            tainted,
+            batch=self.batch,
+        )
+
+        # stops
+        for stop in results.stop:
+            self.plan.append_stopped_alloc(
+                stop.alloc, stop.reason, stop.client_status
+            )
+        # in-place updates: same node, new job version
+        for upd in results.inplace_update:
+            a = upd.alloc.copy_for_update()
+            a.job = upd.new_job
+            a.job_version = upd.new_job.version
+            self.plan.append_alloc(a)
+        # destructive updates: stop old + place new
+        destructive_places = []
+        for old, pr in results.destructive_update:
+            self.plan.append_stopped_alloc(
+                old, "alloc updated in-place failed; destructive update"
+            )
+            destructive_places.append(pr)
+
+        placements = results.place + destructive_places
+
+        # delayed reschedules become followup evals (generic_sched.go:718-753);
+        # the failed alloc is updated in-plan with followup_eval_id so later
+        # reconciles don't spawn duplicates (reconcile.py checks it)
+        now = time.time()
+        by_delay: dict[float, Evaluation] = {}
+        for alloc, delay in results.disconnect_followups:
+            f = by_delay.get(delay)
+            if f is None:
+                f = ev.create_failed_follow_up_eval(delay, now)
+                by_delay[delay] = f
+                self.followup_evals.append(f)
+            linked = alloc.copy_for_update()
+            linked.followup_eval_id = f.id
+            self.plan.append_alloc(linked)
+
+        self.queued_allocs = {
+            tg: c["place"] for tg, c in results.desired_tg_updates.items()
+        }
+
+        if placements and self.job is not None:
+            self._compute_placements(placements, tainted)
+
+        if self.plan.is_no_op() and not self.followup_evals:
+            self._finished = True
+            return True, False
+
+        for f in self.followup_evals:
+            self.planner.create_eval(f)
+        # link placements awaiting delayed evals
+        result, new_snap = self.planner.submit_plan(self.plan)
+        if new_snap is not None:
+            self.snapshot = new_snap
+
+        full, expected, actual = result.full_commit(self.plan)
+        if not full:
+            # partial commit — retry against refreshed state
+            return False, True
+        self._finished = True
+        return True, False
+
+    # -- placement via the device kernel ---------------------------------
+    def _compute_placements(self, placements, tainted) -> None:
+        """Batch all of this eval's placements into one device pass
+        (replaces computePlacements' per-alloc stack.Select walk)."""
+        snap = self.snapshot
+        nodes_sorted = sorted(
+            (n for n in snap.nodes()), key=lambda n: n.id
+        )
+        ct = flatten_cluster(snap, nodes_sorted)
+        # overlay this plan's own stops (evicted allocs free capacity)
+        for node_id, stops in self.plan.node_update.items():
+            row = ct.node_row.get(node_id)
+            if row is None:
+                continue
+            for a in stops:
+                ct.used[row] -= a.comparable_resources().to_vector()
+
+        # group placements by task group
+        by_tg: dict[str, list] = {}
+        for pr in placements:
+            by_tg.setdefault(pr.task_group.name, []).append(pr)
+
+        asks = []
+        tg_order = []
+        for tg_name, prs in by_tg.items():
+            tg = self.job.lookup_task_group(tg_name)
+            penalty_nodes = {
+                pr.reschedule_penalty_node
+                for pr in prs
+                if pr.reschedule_penalty_node
+            }
+            ga = flatten_group_ask(
+                ct,
+                snap,
+                self.job,
+                tg,
+                len(prs),
+                nodes_sorted=nodes_sorted,
+                penalty_node_ids=penalty_nodes,
+            )
+            asks.append(ga)
+            tg_order.append((tg_name, prs, tg))
+
+        results = self.kernel.place(ct, asks)
+
+        nodes_available = {}
+        for n in nodes_sorted:
+            if n.ready():
+                nodes_available[n.datacenter] = (
+                    nodes_available.get(n.datacenter, 0) + 1
+                )
+        for (tg_name, prs, tg), res in zip(tg_order, results):
+            ask_res = tg.combined_resources()
+            comparable = ComparableResources(
+                cpu=ask_res.cpu,
+                memory_mb=ask_res.memory_mb,
+                disk_mb=ask_res.disk_mb,
+                bandwidth_mbits=ask_res.bandwidth_mbits(),
+            )
+            n_failed = 0
+            for pr, row, score in zip(prs, res.node_rows, res.scores):
+                metric = AllocMetric(
+                    nodes_evaluated=ct.num_nodes,
+                    nodes_available=dict(nodes_available),
+                )
+                if row < 0:
+                    n_failed += 1
+                    metric.coalesced_failures = 0
+                    self._record_failure(tg_name, metric)
+                    continue
+                node_id = ct.node_ids[row]
+                metric.scores[f"{node_id}.score"] = float(score)
+                alloc = Allocation(
+                    id=new_id(),
+                    namespace=self.job.namespace,
+                    eval_id=self.eval.id,
+                    name=pr.name,
+                    node_id=node_id,
+                    job_id=self.job.id,
+                    job=self.job,
+                    job_version=self.job.version,
+                    task_group=tg_name,
+                    resources=comparable.copy(),
+                    desired_status=ALLOC_DESIRED_RUN,
+                    client_status="pending",
+                    metrics=metric,
+                )
+                if pr.previous_alloc is not None:
+                    alloc.previous_allocation = pr.previous_alloc.id
+                    prev = pr.previous_alloc
+                    if prev.client_status in ("failed", "lost"):
+                        # carry the reschedule history forward + record this
+                        # attempt (generic_sched.go updateRescheduleTracker)
+                        from ..structs import RescheduleEvent, RescheduleTracker
+
+                        events = list(
+                            prev.reschedule_tracker.events
+                            if prev.reschedule_tracker
+                            else []
+                        )
+                        events.append(
+                            RescheduleEvent(
+                                reschedule_time_ns=time.time_ns(),
+                                prev_alloc_id=prev.id,
+                                prev_node_id=prev.node_id,
+                            )
+                        )
+                        alloc.reschedule_tracker = RescheduleTracker(events=events)
+                self.plan.append_alloc(alloc)
+
+    def _record_failure(self, tg_name: str, metric: AllocMetric) -> None:
+        existing = self.failed_tg_allocs.get(tg_name)
+        if existing is not None:
+            existing.coalesced_failures += 1
+        else:
+            self.failed_tg_allocs[tg_name] = metric
+
+    # -- completion -------------------------------------------------------
+    def _finalize(self) -> None:
+        ev = self.eval
+        if self.failed_tg_allocs and not self.batch:
+            # create/update blocked eval to hold unplaced work
+            # (generic_sched.go:193-212)
+            blocked = ev.create_blocked_eval({}, True, "", self.failed_tg_allocs)
+            blocked.status_description = BLOCKED_EVAL_FAILED_PLACEMENTS_DESC
+            # record the snapshot the failure was computed against, so the
+            # blocked-evals tracker can detect missed unblocks
+            blocked.snapshot_index = getattr(self.snapshot, "index", 0)
+            self.planner.create_eval(blocked)
+            self.blocked = blocked
+        self._set_status(EVAL_STATUS_COMPLETE, "")
+
+    def _set_status(self, status: str, desc: str) -> None:
+        ev = self.eval
+        import copy
+
+        updated = copy.copy(ev)
+        updated.status = status
+        updated.status_description = desc
+        updated.failed_tg_allocs = dict(self.failed_tg_allocs)
+        updated.queued_allocations = dict(self.queued_allocs)
+        self.planner.update_eval(updated)

@@ -1,0 +1,174 @@
+"""SystemScheduler — place one alloc per feasible node (system/sysbatch).
+
+Reference: scheduler/scheduler_system.go (:27 SystemScheduler, :72 Process).
+Where the generic scheduler asks "which node for each alloc", the system
+scheduler asks "which nodes at all" — on device that's simply the
+feasibility mask itself: every eligible node that fits gets a placement,
+computed in one vectorized pass (no greedy scan needed; allocs of a system
+job never stack on one node).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..device import flatten_cluster, flatten_group_ask
+from ..device.score import score_matrix_kernel
+from ..structs import (
+    ALLOC_DESIRED_RUN,
+    Allocation,
+    AllocMetric,
+    ComparableResources,
+    EVAL_STATUS_COMPLETE,
+    Evaluation,
+    new_id,
+)
+from .generic import tainted_nodes
+from .reconcile import REASON_ALLOC_LOST, REASON_ALLOC_NOT_NEEDED
+from .scheduler import Planner, register_scheduler
+
+MAX_SYSTEM_SCHEDULE_ATTEMPTS = 5  # scheduler_system.go:12-21
+
+
+@register_scheduler("system")
+@register_scheduler("sysbatch")
+class SystemScheduler:
+    def __init__(self, snapshot, planner: Planner, *, sysbatch: bool = False):
+        self.snapshot = snapshot
+        self.planner = planner
+        self.sysbatch = sysbatch
+        self.eval = None
+        self.job = None
+        self.plan = None
+        self.failed_tg_allocs: dict[str, AllocMetric] = {}
+
+    def process(self, evaluation: Evaluation) -> None:
+        self.eval = evaluation
+        self.sysbatch = self.sysbatch or evaluation.type == "sysbatch"
+        for _ in range(MAX_SYSTEM_SCHEDULE_ATTEMPTS):
+            if self._process_once():
+                break
+        import copy
+
+        updated = copy.copy(evaluation)
+        updated.status = EVAL_STATUS_COMPLETE
+        updated.failed_tg_allocs = dict(self.failed_tg_allocs)
+        self.planner.update_eval(updated)
+
+    def _process_once(self) -> bool:
+        ev = self.eval
+        self.job = self.snapshot.job_by_id(ev.namespace, ev.job_id)
+        self.plan = ev.make_plan(self.job)
+        existing = self.snapshot.allocs_by_job(ev.namespace, ev.job_id)
+        tainted = tainted_nodes(self.snapshot, existing)
+
+        live_by_node_group: dict[tuple[str, str], Allocation] = {}
+        for a in existing:
+            if a.terminal_status():
+                # a completed sysbatch alloc satisfies its node permanently
+                # (the batch don't-rerun rule, scheduler_system.go sysbatch)
+                if self.sysbatch and a.client_status == "complete":
+                    live_by_node_group.setdefault((a.node_id, a.task_group), a)
+                continue
+            node = tainted.get(a.node_id)
+            if node is not None:
+                if node.terminal_status():
+                    self.plan.append_lost_alloc(a)
+                else:
+                    self.plan.append_stopped_alloc(
+                        a, "alloc stopped because node is draining"
+                    )
+                continue
+            live_by_node_group[(a.node_id, a.task_group)] = a
+
+        stopped_job = self.job is None or self.job.stopped()
+        if stopped_job:
+            for a in live_by_node_group.values():
+                self.plan.append_stopped_alloc(a, REASON_ALLOC_NOT_NEEDED)
+            return self._submit()
+
+        nodes_sorted = sorted(self.snapshot.nodes(), key=lambda n: n.id)
+        ct = flatten_cluster(self.snapshot, nodes_sorted)
+
+        for tg in self.job.task_groups:
+            ga = flatten_group_ask(
+                ct, self.snapshot, self.job, tg, 1, nodes_sorted=nodes_sorted
+            )
+            finals, fits = score_matrix_kernel(
+                np.asarray(ct.capacity),
+                np.asarray(ct.used),
+                ga.ask[None, :],
+                ga.eligible[None, :],
+                ga.job_counts[None, :],
+                np.array([float(max(tg.count, 1))], dtype=np.float32),
+                ga.penalty_nodes[None, :],
+                ga.affinity_scores[None, :],
+                np.array([ga.has_affinities]),
+                np.array([ga.distinct_hosts]),
+                np.asarray(False),
+            )
+            finals = np.asarray(finals)[0]
+            fits_np = np.asarray(fits)[0]
+            eligible_rows = np.nonzero(ga.eligible[: ct.num_nodes])[0]
+            ask_res = tg.combined_resources()
+            comparable = ComparableResources(
+                cpu=ask_res.cpu,
+                memory_mb=ask_res.memory_mb,
+                disk_mb=ask_res.disk_mb,
+                bandwidth_mbits=ask_res.bandwidth_mbits(),
+            )
+            for row in eligible_rows:
+                node_id = ct.node_ids[row]
+                if (node_id, tg.name) in live_by_node_group:
+                    continue  # already running there
+                if not fits_np[row]:
+                    m = AllocMetric(nodes_evaluated=1)
+                    m.exhausted_node(node_id, "resources")
+                    self._record_failure(tg.name, m)
+                    continue
+                metric = AllocMetric(nodes_evaluated=1)
+                metric.scores[f"{node_id}.score"] = float(finals[row])
+                self.plan.append_alloc(
+                    Allocation(
+                        id=new_id(),
+                        namespace=self.job.namespace,
+                        eval_id=ev.id,
+                        name=f"{self.job.id}.{tg.name}[0]",
+                        node_id=node_id,
+                        job_id=self.job.id,
+                        job=self.job,
+                        job_version=self.job.version,
+                        task_group=tg.name,
+                        resources=comparable.copy(),
+                        desired_status=ALLOC_DESIRED_RUN,
+                        client_status="pending",
+                        metrics=metric,
+                    )
+                )
+            # stop allocs on nodes no longer eligible (e.g. constraint change)
+            eligible_ids = {ct.node_ids[r] for r in eligible_rows}
+            for (node_id, tg_name), a in list(live_by_node_group.items()):
+                if (
+                    tg_name == tg.name
+                    and node_id not in eligible_ids
+                    and not a.terminal_status()
+                ):
+                    self.plan.append_stopped_alloc(a, REASON_ALLOC_NOT_NEEDED)
+
+        return self._submit()
+
+    def _record_failure(self, tg_name: str, metric: AllocMetric) -> None:
+        existing = self.failed_tg_allocs.get(tg_name)
+        if existing is not None:
+            existing.coalesced_failures += 1
+        else:
+            self.failed_tg_allocs[tg_name] = metric
+
+    def _submit(self) -> bool:
+        if self.plan.is_no_op():
+            return True
+        result, new_snap = self.planner.submit_plan(self.plan)
+        if new_snap is not None:
+            self.snapshot = new_snap
+        full, _, _ = result.full_commit(self.plan)
+        return full

@@ -1,0 +1,6 @@
+"""L1 server core: composition root, workers, leader services."""
+
+from .server import Server, ServerConfig
+from .worker import Worker
+
+__all__ = ["Server", "ServerConfig", "Worker"]

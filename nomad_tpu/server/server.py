@@ -1,0 +1,299 @@
+"""Server — the composition root: state, queues, applier, workers.
+
+Reference: nomad/server.go (:95-259 Server, :293 NewServer) and
+nomad/leader.go (:230-347 establishLeadership: enable plan queue, spawn
+planApply, enable eval broker + blocked evals, restore queues from durable
+state, pause half the workers).
+
+Round-1 scope: a single-process server whose "Raft apply" is a serialized
+in-memory commit with monotonically increasing indexes (the consensus
+transport slots in behind ``_raft_apply`` later — SURVEY.md §7 step 8
+explicitly sequences "single-node WAL first"). Everything above that seam
+— eval lifecycle, node-update fan-out to evals, blocked-eval unblocking on
+capacity change, worker scheduling through the plan queue — is the real
+protocol.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Iterable, Optional
+
+from ..broker.blocked import BlockedEvals
+from ..broker.eval_broker import EvalBroker
+from ..broker.plan_queue import PlanApplyLoop, PlanQueue
+from ..state import StateStore
+from ..structs import (
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_PENDING,
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    TRIGGER_JOB_REGISTER,
+    TRIGGER_NODE_UPDATE,
+    new_id,
+)
+from ..structs.evaluation import (
+    EVAL_STATUS_COMPLETE,
+    TRIGGER_JOB_DEREGISTER,
+    TRIGGER_RETRY_FAILED_ALLOC,
+)
+from .worker import Worker
+
+log = logging.getLogger("nomad_tpu.server")
+
+
+class ServerConfig:
+    def __init__(self, num_workers: int = 2, region: str = "global"):
+        self.num_workers = num_workers
+        self.region = region
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None):
+        self.config = config or ServerConfig()
+        self.store = StateStore()
+        self.eval_broker = EvalBroker()
+        self.blocked_evals = BlockedEvals(broker=self.eval_broker)
+        self.plan_queue = PlanQueue()
+        self.plan_apply_loop = PlanApplyLoop(self.store, self.plan_queue)
+        self.workers: list[Worker] = []
+        self._raft_lock = threading.Lock()
+        self._leader = False
+        # capacity changes unblock blocked evals (blocked_evals.go:55)
+        self.store.add_listener(self._on_state_change)
+
+    # -- raft seam ---------------------------------------------------------
+    def _raft_apply(self, fn) -> int:
+        """Serialized commit: allocate the next index and apply. The Raft
+        log + FSM replay slots in here without touching callers."""
+        with self._raft_lock:
+            index = self.store.latest_index + 1
+            fn(index)
+            return index
+
+    # -- leadership --------------------------------------------------------
+    def establish_leadership(self) -> None:
+        """leader.go:230-347."""
+        self._leader = True
+        self.plan_queue.set_enabled(True)
+        self.plan_apply_loop.start()
+        self.eval_broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self._restore_evals()
+        for i in range(self.config.num_workers):
+            w = Worker(self, worker_id=i)
+            self.workers.append(w)
+            w.start()
+
+    def revoke_leadership(self) -> None:
+        for w in self.workers:
+            w.stop()
+        self.workers.clear()
+        self.plan_apply_loop.stop()
+        self.plan_queue.set_enabled(False)
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self._leader = False
+
+    def shutdown(self) -> None:
+        if self._leader:
+            self.revoke_leadership()
+
+    def _restore_evals(self) -> None:
+        """Re-populate broker/blocked from durable state on leadership
+        (leader.go:269 restoreEvals)."""
+        for ev in self.store.evals():
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    # -- API: jobs ---------------------------------------------------------
+    def register_job(self, job: Job) -> Evaluation:
+        """Job.Register (nomad/job_endpoint.go): upsert job + create eval
+        in one commit, then enqueue."""
+        ev = Evaluation(
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            status=EVAL_STATUS_PENDING,
+        )
+
+        def apply(index):
+            self.store.upsert_job(index, job)
+            ev.job_modify_index = index
+            self.store.upsert_evals(index, [ev])
+
+        self._raft_apply(apply)
+        self.blocked_evals.untrack(job.namespace, job.id)
+        if not job.is_periodic() and not job.is_parameterized():
+            self.eval_broker.enqueue(ev)
+        return ev
+
+    def deregister_job(self, namespace: str, job_id: str) -> Optional[Evaluation]:
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        import copy
+
+        stopped = copy.deepcopy(job)
+        stopped.stop = True
+        ev = Evaluation(
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=TRIGGER_JOB_DEREGISTER,
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+        )
+
+        def apply(index):
+            self.store.upsert_job(index, stopped)
+            self.store.upsert_evals(index, [ev])
+
+        self._raft_apply(apply)
+        self.blocked_evals.untrack(namespace, job_id)
+        self.eval_broker.enqueue(ev)
+        return ev
+
+    # -- API: nodes --------------------------------------------------------
+    def register_node(self, node: Node) -> None:
+        self._raft_apply(lambda index: self.store.upsert_node(index, node))
+
+    def update_node_status(self, node_id: str, status: str) -> list[Evaluation]:
+        """Node.UpdateStatus: commit + fan out node-update evals for every
+        job with allocs on the node (nomad/node_endpoint.go createNodeEvals)."""
+        self._raft_apply(
+            lambda index: self.store.update_node_status(index, node_id, status)
+        )
+        return self._create_node_evals(node_id)
+
+    def update_node_drain(self, node_id: str, drain) -> list[Evaluation]:
+        self._raft_apply(
+            lambda index: self.store.update_node_drain(index, node_id, drain)
+        )
+        return self._create_node_evals(node_id)
+
+    def _create_node_evals(self, node_id: str) -> list[Evaluation]:
+        jobs = {}
+        for a in self.store.allocs_by_node(node_id):
+            if not a.terminal_status() or a.client_status == "failed":
+                jobs[(a.namespace, a.job_id)] = a
+        evals = []
+        for (ns, job_id), a in jobs.items():
+            job = self.store.job_by_id(ns, job_id)
+            evals.append(
+                Evaluation(
+                    namespace=ns,
+                    priority=job.priority if job else 50,
+                    type=job.type if job else "service",
+                    triggered_by=TRIGGER_NODE_UPDATE,
+                    job_id=job_id,
+                    node_id=node_id,
+                    status=EVAL_STATUS_PENDING,
+                )
+            )
+        # system jobs must also react to new/changed nodes
+        node = self.store.node_by_id(node_id)
+        if node is not None and node.ready():
+            for job in self.store.jobs():
+                if job.type in ("system", "sysbatch") and not job.stopped():
+                    evals.append(
+                        Evaluation(
+                            namespace=job.namespace,
+                            priority=job.priority,
+                            type=job.type,
+                            triggered_by=TRIGGER_NODE_UPDATE,
+                            job_id=job.id,
+                            node_id=node_id,
+                            status=EVAL_STATUS_PENDING,
+                        )
+                    )
+        if evals:
+            self._raft_apply(lambda index: self.store.upsert_evals(index, evals))
+            self.eval_broker.enqueue_all(evals)
+        return evals
+
+    # -- API: client alloc updates ----------------------------------------
+    def update_allocs_from_client(self, updates: Iterable[Allocation]) -> None:
+        updates = list(updates)
+        self._raft_apply(
+            lambda index: self.store.update_allocs_from_client(index, updates)
+        )
+        # terminal client statuses free capacity ⇒ unblock held evals
+        if any(
+            u.client_status in ("complete", "failed", "lost") for u in updates
+        ):
+            self.blocked_evals.unblock(index=self.store.latest_index)
+        # failed allocs trigger reschedule evals (node_endpoint.go)
+        evals = []
+        seen = set()
+        for upd in updates:
+            if upd.client_status != "failed":
+                continue
+            a = self.store.alloc_by_id(upd.id)
+            if a is None or (a.namespace, a.job_id) in seen:
+                continue
+            seen.add((a.namespace, a.job_id))
+            job = self.store.job_by_id(a.namespace, a.job_id)
+            if job is None or job.stopped():
+                continue
+            evals.append(
+                Evaluation(
+                    namespace=a.namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=TRIGGER_RETRY_FAILED_ALLOC,
+                    job_id=a.job_id,
+                    status=EVAL_STATUS_PENDING,
+                )
+            )
+        if evals:
+            self._raft_apply(lambda index: self.store.upsert_evals(index, evals))
+            self.eval_broker.enqueue_all(evals)
+
+    # -- eval lifecycle (worker callbacks) ---------------------------------
+    def apply_eval_update(self, evals: list[Evaluation]) -> None:
+        self._raft_apply(lambda index: self.store.upsert_evals(index, evals))
+        for ev in evals:
+            if ev.status == EVAL_STATUS_BLOCKED:
+                self.blocked_evals.block(ev)
+
+    def apply_eval_create(self, evals: list[Evaluation]) -> None:
+        self._raft_apply(lambda index: self.store.upsert_evals(index, evals))
+        for ev in evals:
+            if ev.status == EVAL_STATUS_BLOCKED:
+                self.blocked_evals.block(ev)
+            elif ev.wait_until_unix:
+                self.eval_broker.enqueue(ev)
+            elif ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+
+    # -- state-change fan-out ----------------------------------------------
+    def _on_state_change(self, table: str, index: int) -> None:
+        if table == "nodes":
+            # capacity may have appeared: unblock everything eligible
+            self.blocked_evals.unblock(index=index)
+
+    # -- convenience -------------------------------------------------------
+    def wait_for_evals(self, timeout: float = 10.0) -> bool:
+        """Test/ops helper: wait until no ready or in-flight evals remain."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self.eval_broker._lock:
+                busy = (
+                    self.eval_broker.ready_count()
+                    + len(self.eval_broker._unack)
+                    + len(self.eval_broker._delayed)
+                )
+            if busy == 0 and self.plan_queue.depth() == 0:
+                return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,112 @@
+"""Worker — the scheduling worker loop.
+
+Reference: nomad/worker.go — run (:385-432): dequeue an eval, wait for the
+state store to catch up to the eval's index (snapshotMinIndex :536-549),
+invoke the scheduler on a snapshot (:552-581), ack on success / nack on
+failure (:818-838). The worker is also the scheduler's Planner: SubmitPlan
+(:585-652) attaches the eval token + snapshot index, submits to the plan
+queue, waits the future, and on a RefreshIndex result hands the scheduler
+a fresher snapshot.
+
+The TPU twist (SURVEY.md §2.7): one worker drives a *batched* device pass,
+so a single worker replaces N CPU-bound Go workers for placement; multiple
+workers still make sense to overlap host-side reconcile/flatten work.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..scheduler import new_scheduler
+from ..structs import Evaluation, Plan
+
+log = logging.getLogger("nomad_tpu.worker")
+
+SCHEDULER_TYPES = ["service", "batch", "system", "sysbatch", "_core"]
+
+
+class Worker:
+    def __init__(self, server, worker_id: int = 0, schedulers=None):
+        self.server = server
+        self.id = worker_id
+        self.schedulers = schedulers or SCHEDULER_TYPES
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._eval_token: str = ""
+        self.stats = {"processed": 0, "acked": 0, "nacked": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self.run, name=f"worker-{self.id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def pause(self) -> None:
+        """Leader pauses half its workers (nomad/leader.go:231-233)."""
+        self._paused.set()
+
+    def resume(self) -> None:
+        self._paused.clear()
+
+    # -- main loop ---------------------------------------------------------
+    def run(self) -> None:
+        while not self._stop.is_set():
+            if self._paused.is_set():
+                self._stop.wait(0.1)
+                continue
+            ev, token = self.server.eval_broker.dequeue(
+                self.schedulers, timeout=0.2
+            )
+            if ev is None:
+                continue
+            self._eval_token = token
+            try:
+                self.process_eval(ev)
+                self.server.eval_broker.ack(ev.id, token)
+                self.stats["acked"] += 1
+            except Exception:
+                log.exception("worker %d: eval %s failed", self.id, ev.id)
+                try:
+                    self.server.eval_broker.nack(ev.id, token)
+                except ValueError:
+                    pass
+                self.stats["nacked"] += 1
+            self.stats["processed"] += 1
+
+    def process_eval(self, ev: Evaluation) -> None:
+        # raft catch-up barrier (worker.go:536-549)
+        self.server.store.wait_for_index(ev.modify_index, timeout=5.0)
+        snapshot = self.server.store.snapshot()
+        sched = new_scheduler(ev.type, snapshot, self)
+        sched.process(ev)
+
+    # -- Planner interface (worker.go:585-767) -----------------------------
+    def submit_plan(self, plan: Plan):
+        plan.eval_token = self._eval_token
+        plan.normalize()
+        future = self.server.plan_queue.enqueue(plan)
+        result = future.result(timeout=30)
+        new_snapshot = None
+        if result.refresh_index:
+            self.server.store.wait_for_index(result.refresh_index, timeout=5.0)
+            new_snapshot = self.server.store.snapshot()
+        return result, new_snapshot
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.apply_eval_update([ev])
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self.server.apply_eval_create([ev])
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.eval_broker.enqueue(ev)

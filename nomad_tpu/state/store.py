@@ -1,0 +1,527 @@
+"""StateStore — MVCC snapshot store with index watermarks.
+
+Reference: nomad/state/state_store.go (6,446 LoC on go-memdb) and
+nomad/fsm.go (Raft log application). The semantics that matter and are
+kept here:
+
+- **Snapshot isolation.** Schedulers run against an immutable snapshot
+  while writers proceed (memdb MVCC). Implemented as copy-on-first-write-
+  after-snapshot: ``snapshot()`` freezes the current table dicts; the next
+  write to a frozen table copies it. Secondary-index values are immutable
+  ``frozenset``s so snapshots share them safely.
+- **Index watermarks.** Every write carries a monotonically increasing
+  index (the Raft log index analog). ``wait_for_index`` is the worker's
+  ``snapshotMinIndex`` barrier (nomad/worker.go:536-549): don't schedule
+  an eval against state older than the index that created it.
+- **UpsertPlanResults** applies a committed plan atomically: stops,
+  placements, preemptions, eval updates (state_store.go UpsertPlanResults).
+- **Blocking queries.** A condition variable broadcast on every index bump
+  backs blocking/watch reads (memdb WatchSet analog).
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Callable, Iterable, Optional
+
+from ..structs import (
+    ALLOC_CLIENT_LOST,
+    ALLOC_DESIRED_STOP,
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    PlanResult,
+)
+
+JOB_TRACKED_VERSIONS = 6  # structsJobTrackedVersions
+
+
+class SchedulerConfiguration:
+    """Runtime scheduler config stored in state (the Raft-resident knob the
+    TPU algorithm registers under). Reference: structs.SchedulerConfiguration
+    (nomad/structs/operator.go:128-220, default binpack :164-169)."""
+
+    def __init__(
+        self,
+        scheduler_algorithm: str = "binpack",
+        preemption_system_enabled: bool = True,
+        preemption_batch_enabled: bool = False,
+        preemption_service_enabled: bool = False,
+        memory_oversubscription_enabled: bool = False,
+        pause_eval_broker: bool = False,
+    ):
+        self.scheduler_algorithm = scheduler_algorithm
+        self.preemption_system_enabled = preemption_system_enabled
+        self.preemption_batch_enabled = preemption_batch_enabled
+        self.preemption_service_enabled = preemption_service_enabled
+        self.memory_oversubscription_enabled = memory_oversubscription_enabled
+        self.pause_eval_broker = pause_eval_broker
+
+
+class _Tables:
+    """The raw table/index dict bundle shared between store and snapshots."""
+
+    __slots__ = (
+        "nodes",
+        "jobs",
+        "job_versions",
+        "evals",
+        "allocs",
+        "allocs_by_node",
+        "allocs_by_job",
+        "evals_by_job",
+        "deployments",
+        "deployments_by_job",
+        "indexes",
+        "scheduler_config",
+    )
+
+    def __init__(self):
+        self.nodes: dict[str, Node] = {}
+        self.jobs: dict[tuple[str, str], Job] = {}
+        self.job_versions: dict[tuple[str, str], tuple] = {}
+        self.evals: dict[str, Evaluation] = {}
+        self.allocs: dict[str, Allocation] = {}
+        self.allocs_by_node: dict[str, frozenset[str]] = {}
+        self.allocs_by_job: dict[tuple[str, str], frozenset[str]] = {}
+        self.evals_by_job: dict[tuple[str, str], frozenset[str]] = {}
+        self.deployments: dict[str, object] = {}
+        self.deployments_by_job: dict[tuple[str, str], frozenset[str]] = {}
+        self.indexes: dict[str, int] = {}
+        self.scheduler_config: SchedulerConfiguration = SchedulerConfiguration()
+
+    TABLE_NAMES = (
+        "nodes",
+        "jobs",
+        "job_versions",
+        "evals",
+        "allocs",
+        "allocs_by_node",
+        "allocs_by_job",
+        "evals_by_job",
+        "deployments",
+        "deployments_by_job",
+        "indexes",
+    )
+
+
+class StateSnapshot:
+    """An immutable point-in-time view. All read methods of StateStore are
+    defined on this class; the store itself reads through a live view."""
+
+    def __init__(self, tables: _Tables, index: int):
+        self._t = tables
+        self.index = index
+
+    # -- nodes ------------------------------------------------------------
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._t.nodes.get(node_id)
+
+    def nodes(self) -> Iterable[Node]:
+        return self._t.nodes.values()
+
+    def ready_nodes_in_dcs(self, datacenters: Iterable[str]) -> list[Node]:
+        """readyNodesInDCs (scheduler/util.go:279)."""
+        dcs = set(datacenters)
+        return [n for n in self._t.nodes.values() if n.ready() and n.datacenter in dcs]
+
+    # -- jobs -------------------------------------------------------------
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self._t.jobs.get((namespace, job_id))
+
+    def jobs(self) -> Iterable[Job]:
+        return self._t.jobs.values()
+
+    def job_version(self, namespace: str, job_id: str, version: int) -> Optional[Job]:
+        for j in self._t.job_versions.get((namespace, job_id), ()):
+            if j.version == version:
+                return j
+        return None
+
+    # -- evals ------------------------------------------------------------
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._t.evals.get(eval_id)
+
+    def evals(self) -> Iterable[Evaluation]:
+        return self._t.evals.values()
+
+    def evals_by_job(self, namespace: str, job_id: str) -> list[Evaluation]:
+        ids = self._t.evals_by_job.get((namespace, job_id), frozenset())
+        return [self._t.evals[i] for i in ids if i in self._t.evals]
+
+    # -- allocs -----------------------------------------------------------
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._t.allocs.get(alloc_id)
+
+    def allocs(self) -> Iterable[Allocation]:
+        return self._t.allocs.values()
+
+    def allocs_by_node(self, node_id: str) -> list[Allocation]:
+        ids = self._t.allocs_by_node.get(node_id, frozenset())
+        return [self._t.allocs[i] for i in ids if i in self._t.allocs]
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> list[Allocation]:
+        return [
+            a for a in self.allocs_by_node(node_id) if a.terminal_status() == terminal
+        ]
+
+    def allocs_by_job(self, namespace: str, job_id: str) -> list[Allocation]:
+        ids = self._t.allocs_by_job.get((namespace, job_id), frozenset())
+        return [self._t.allocs[i] for i in ids if i in self._t.allocs]
+
+    def allocs_by_eval(self, eval_id: str) -> list[Allocation]:
+        return [a for a in self._t.allocs.values() if a.eval_id == eval_id]
+
+    # -- deployments ------------------------------------------------------
+    def deployment_by_id(self, deployment_id: str):
+        return self._t.deployments.get(deployment_id)
+
+    def latest_deployment_by_job(self, namespace: str, job_id: str):
+        ids = self._t.deployments_by_job.get((namespace, job_id), frozenset())
+        best = None
+        for i in ids:
+            d = self._t.deployments.get(i)
+            if d is not None and (best is None or d.create_index > best.create_index):
+                best = d
+        return best
+
+    # -- meta -------------------------------------------------------------
+    def scheduler_config(self) -> SchedulerConfiguration:
+        return self._t.scheduler_config
+
+    def table_index(self, table: str) -> int:
+        return self._t.indexes.get(table, 0)
+
+
+class StateStore(StateSnapshot):
+    """The live, writable store. Reads see the latest committed state."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._frozen: set[str] = set()
+        self._latest_index = 0
+        self._listeners: list[Callable[[str, int], None]] = []
+        super().__init__(_Tables(), 0)
+
+    # -- snapshot machinery ----------------------------------------------
+    @property
+    def latest_index(self) -> int:
+        return self._latest_index
+
+    def snapshot(self) -> StateSnapshot:
+        """Freeze current tables; writers copy-on-first-write after this."""
+        with self._lock:
+            self._frozen = set(_Tables.TABLE_NAMES)
+            return StateSnapshot(self._shallow_tables(), self._latest_index)
+
+    def _shallow_tables(self) -> _Tables:
+        t = _Tables.__new__(_Tables)
+        for name in _Tables.TABLE_NAMES:
+            setattr(t, name, getattr(self._t, name))
+        t.scheduler_config = self._t.scheduler_config
+        return t
+
+    def _own(self, table: str) -> dict:
+        d = getattr(self._t, table)
+        if table in self._frozen:
+            d = dict(d)
+            setattr(self._t, table, d)
+            self._frozen.discard(table)
+        return d
+
+    def _bump(self, index: int, *tables: str) -> None:
+        self._latest_index = max(self._latest_index, index)
+        idx = self._own("indexes")
+        for tb in tables:
+            idx[tb] = index
+        self._cond.notify_all()
+        for fn in self._listeners:
+            for tb in tables:
+                fn(tb, index)
+
+    def add_listener(self, fn: Callable[[str, int], None]) -> None:
+        """Table-change listener (the event-broker / blocked-evals hook)."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def wait_for_index(self, index: int, timeout: float = 5.0) -> bool:
+        """snapshotMinIndex barrier (worker.go:536-549)."""
+        deadline = _time.monotonic() + timeout
+        with self._lock:
+            while self._latest_index < index:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    # -- index maintenance helpers ---------------------------------------
+    @staticmethod
+    def _idx_add(d: dict, key, value: str) -> None:
+        d[key] = d.get(key, frozenset()) | {value}
+
+    @staticmethod
+    def _idx_del(d: dict, key, value: str) -> None:
+        cur = d.get(key)
+        if cur is None:
+            return
+        nxt = cur - {value}
+        if nxt:
+            d[key] = nxt
+        else:
+            d.pop(key, None)
+
+    # -- nodes ------------------------------------------------------------
+    def upsert_node(self, index: int, node: Node) -> None:
+        with self._lock:
+            nodes = self._own("nodes")
+            existing = nodes.get(node.id)
+            if existing is not None:
+                node.create_index = existing.create_index
+            else:
+                node.create_index = index
+            node.modify_index = index
+            if not node.computed_class:
+                node.compute_class()
+            nodes[node.id] = node
+            self._bump(index, "nodes")
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            self._own("nodes").pop(node_id, None)
+            self._bump(index, "nodes")
+
+    def update_node_status(self, index: int, node_id: str, status: str) -> None:
+        with self._lock:
+            nodes = self._own("nodes")
+            n = nodes.get(node_id)
+            if n is None:
+                raise KeyError(f"node {node_id} not found")
+            import copy
+
+            n2 = copy.copy(n)
+            n2.status = status
+            n2.modify_index = index
+            nodes[node_id] = n2
+            self._bump(index, "nodes")
+
+    def update_node_eligibility(self, index: int, node_id: str, elig: str) -> None:
+        with self._lock:
+            nodes = self._own("nodes")
+            n = nodes.get(node_id)
+            if n is None:
+                raise KeyError(f"node {node_id} not found")
+            import copy
+
+            n2 = copy.copy(n)
+            n2.scheduling_eligibility = elig
+            n2.modify_index = index
+            nodes[node_id] = n2
+            self._bump(index, "nodes")
+
+    def update_node_drain(self, index: int, node_id: str, drain) -> None:
+        from ..structs import NODE_SCHED_INELIGIBLE, NODE_SCHED_ELIGIBLE
+
+        with self._lock:
+            nodes = self._own("nodes")
+            n = nodes.get(node_id)
+            if n is None:
+                raise KeyError(f"node {node_id} not found")
+            import copy
+
+            n2 = copy.copy(n)
+            n2.drain = drain
+            n2.scheduling_eligibility = (
+                NODE_SCHED_INELIGIBLE if drain is not None else NODE_SCHED_ELIGIBLE
+            )
+            n2.modify_index = index
+            nodes[node_id] = n2
+            self._bump(index, "nodes")
+
+    # -- jobs -------------------------------------------------------------
+    def upsert_job(self, index: int, job: Job) -> None:
+        """UpsertJob: bump version on change, retain bounded version history."""
+        with self._lock:
+            jobs = self._own("jobs")
+            key = job.namespaced_id()
+            existing = jobs.get(key)
+            if existing is not None:
+                job.create_index = existing.create_index
+                job.version = existing.version + 1
+            else:
+                job.create_index = index
+                job.version = 0
+            job.modify_index = index
+            job.job_modify_index = index
+            if job.status not in ("dead",):
+                job.status = "pending" if existing is None else job.status
+            jobs[key] = job
+            versions = self._own("job_versions")
+            hist = (job,) + versions.get(key, ())
+            versions[key] = hist[:JOB_TRACKED_VERSIONS]
+            self._bump(index, "jobs", "job_versions")
+
+    def delete_job(self, index: int, namespace: str, job_id: str) -> None:
+        with self._lock:
+            self._own("jobs").pop((namespace, job_id), None)
+            self._own("job_versions").pop((namespace, job_id), None)
+            self._bump(index, "jobs", "job_versions")
+
+    def update_job_status(self, index: int, namespace: str, job_id: str, status: str):
+        with self._lock:
+            jobs = self._own("jobs")
+            j = jobs.get((namespace, job_id))
+            if j is None:
+                return
+            import copy
+
+            j2 = copy.copy(j)
+            j2.status = status
+            j2.modify_index = index
+            jobs[(namespace, job_id)] = j2
+            self._bump(index, "jobs")
+
+    # -- evals ------------------------------------------------------------
+    def upsert_evals(self, index: int, evals: Iterable[Evaluation]) -> None:
+        with self._lock:
+            table = self._own("evals")
+            by_job = self._own("evals_by_job")
+            for ev in evals:
+                existing = table.get(ev.id)
+                ev.create_index = existing.create_index if existing else index
+                ev.modify_index = index
+                table[ev.id] = ev
+                self._idx_add(by_job, (ev.namespace, ev.job_id), ev.id)
+            self._bump(index, "evals")
+
+    def delete_evals(self, index: int, eval_ids: Iterable[str]) -> None:
+        with self._lock:
+            table = self._own("evals")
+            by_job = self._own("evals_by_job")
+            for eid in eval_ids:
+                ev = table.pop(eid, None)
+                if ev is not None:
+                    self._idx_del(by_job, (ev.namespace, ev.job_id), eid)
+            self._bump(index, "evals")
+
+    # -- allocs -----------------------------------------------------------
+    def upsert_allocs(self, index: int, allocs: Iterable[Allocation]) -> None:
+        with self._lock:
+            self._upsert_allocs_locked(index, allocs)
+            self._bump(index, "allocs")
+
+    def _upsert_allocs_locked(self, index: int, allocs: Iterable[Allocation]) -> None:
+        import copy as _copy
+
+        table = self._own("allocs")
+        by_node = self._own("allocs_by_node")
+        by_job = self._own("allocs_by_job")
+        for a in allocs:
+            # Denormalize: plans ship with alloc.job stripped
+            # (Plan.normalize); re-attach the stored job at the alloc's
+            # version so version diffing / device asks keep working —
+            # mirrors StateStore.DenormalizeAllocationsMap.
+            if a.job is None:
+                j = self._t.jobs.get((a.namespace, a.job_id))
+                if j is not None and j.version != a.job_version:
+                    for old in self._t.job_versions.get((a.namespace, a.job_id), ()):
+                        if old.version == a.job_version:
+                            j = old
+                            break
+                a.job = j
+            # Maintain the replacement chain: the previous alloc learns its
+            # successor (state_store.go UpsertAllocs sets NextAllocation).
+            if a.previous_allocation:
+                prev = table.get(a.previous_allocation)
+                if prev is not None and prev.next_allocation != a.id:
+                    prev2 = _copy.copy(prev)
+                    prev2.next_allocation = a.id
+                    prev2.modify_index = index
+                    table[prev.id] = prev2
+            existing = table.get(a.id)
+            if existing is not None:
+                a.create_index = existing.create_index
+                # Preserve client-reported fields on server-side updates
+                # (state_store.go UpsertAllocs keeps ClientStatus unless
+                # the update sets it).
+                if a.client_status == "" and existing.client_status:
+                    a.client_status = existing.client_status
+                if existing.node_id and existing.node_id != a.node_id:
+                    self._idx_del(by_node, existing.node_id, a.id)
+            else:
+                a.create_index = index
+            a.modify_index = index
+            table[a.id] = a
+            if a.node_id:
+                self._idx_add(by_node, a.node_id, a.id)
+            self._idx_add(by_job, (a.namespace, a.job_id), a.id)
+
+    def update_allocs_from_client(self, index: int, updates: Iterable[Allocation]):
+        """Client status sync (Node.UpdateAlloc): merge client-owned fields
+        onto the server copy."""
+        import copy
+
+        with self._lock:
+            table = self._own("allocs")
+            for upd in updates:
+                existing = table.get(upd.id)
+                if existing is None:
+                    continue
+                a = copy.copy(existing)
+                a.client_status = upd.client_status
+                a.client_description = upd.client_description
+                a.task_states = upd.task_states or a.task_states
+                a.modify_index = index
+                table[a.id] = a
+            self._bump(index, "allocs")
+
+    # -- deployments -------------------------------------------------------
+    def upsert_deployment(self, index: int, deployment) -> None:
+        with self._lock:
+            table = self._own("deployments")
+            existing = table.get(deployment.id)
+            deployment.create_index = existing.create_index if existing else index
+            deployment.modify_index = index
+            table[deployment.id] = deployment
+            self._idx_add(
+                self._own("deployments_by_job"),
+                (deployment.namespace, deployment.job_id),
+                deployment.id,
+            )
+            self._bump(index, "deployments")
+
+    # -- plan results (the FSM's ApplyPlanResults) -------------------------
+    def upsert_plan_results(self, index: int, result: PlanResult, eval_id: str = ""):
+        """Apply a committed plan atomically: stops/evictions, preempted
+        allocs, then placements (state_store.go UpsertPlanResults)."""
+        with self._lock:
+            updates: list[Allocation] = []
+            for allocs in result.node_update.values():
+                updates.extend(allocs)
+            for allocs in result.node_preemptions.values():
+                updates.extend(allocs)
+            for allocs in result.node_allocation.values():
+                updates.extend(allocs)
+            self._upsert_allocs_locked(index, updates)
+            if result.deployment is not None:
+                table = self._own("deployments")
+                d = result.deployment
+                existing = table.get(d.id)
+                d.create_index = existing.create_index if existing else index
+                d.modify_index = index
+                table[d.id] = d
+                self._idx_add(
+                    self._own("deployments_by_job"),
+                    (d.namespace, d.job_id),
+                    d.id,
+                )
+            self._bump(index, "allocs", "deployments")
+
+    # -- scheduler config --------------------------------------------------
+    def set_scheduler_config(self, index: int, cfg: SchedulerConfiguration) -> None:
+        with self._lock:
+            self._t.scheduler_config = cfg
+            self._bump(index, "scheduler_config")

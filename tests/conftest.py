@@ -1,13 +1,28 @@
 """Test config: force an 8-device virtual CPU platform so every sharding
-test exercises a real multi-device mesh without TPU hardware. Must run
-before jax initializes its backends."""
+test exercises a real multi-device mesh without TPU hardware.
+
+The environment ships JAX_PLATFORMS=axon (one real TPU chip over a
+tunnel) and a sitecustomize that imports jax and registers the axon PJRT
+plugin at interpreter startup — so by the time conftest runs, jax is
+already imported with platforms=axon latched from the env. Plain env-var
+edits are too late; ``jax.config.update`` still works because backends are
+initialized lazily (first ``jax.devices()``), and XLA_FLAGS is read by the
+CPU client at that same point.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert (
+    jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8
+), "tests require the 8-device virtual CPU platform"

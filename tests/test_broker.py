@@ -1,0 +1,180 @@
+"""EvalBroker / BlockedEvals / PlanQueue unit tests
+(analog of nomad/eval_broker_test.go, blocked_evals_test.go)."""
+
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.broker.blocked import BlockedEvals
+from nomad_tpu.broker.eval_broker import EvalBroker
+from nomad_tpu.broker.plan_queue import PlanQueue
+from nomad_tpu.structs import Evaluation, Plan
+
+
+def make_broker(**kw):
+    b = EvalBroker(**kw)
+    b.set_enabled(True)
+    return b
+
+
+def ev(priority=50, job="j1", typ="service", **kw):
+    return Evaluation(priority=priority, job_id=job, type=typ, **kw)
+
+
+class TestEvalBroker:
+    def test_enqueue_dequeue_ack(self):
+        b = make_broker()
+        e = ev()
+        b.enqueue(e)
+        got, token = b.dequeue(["service"], timeout=1)
+        assert got is e and token
+        assert b.outstanding(e.id)
+        b.ack(e.id, token)
+        assert not b.outstanding(e.id)
+
+    def test_priority_order(self):
+        b = make_broker()
+        lo, hi = ev(priority=10, job="a"), ev(priority=90, job="b")
+        b.enqueue(lo)
+        b.enqueue(hi)
+        got, t = b.dequeue(["service"], timeout=1)
+        assert got is hi
+        b.ack(got.id, t)
+        got2, _ = b.dequeue(["service"], timeout=1)
+        assert got2 is lo
+
+    def test_scheduler_type_filter(self):
+        b = make_broker()
+        b.enqueue(ev(typ="batch"))
+        got, _ = b.dequeue(["service"], timeout=0.1)
+        assert got is None
+        got, _ = b.dequeue(["batch"], timeout=1)
+        assert got is not None
+
+    def test_per_job_serialization(self):
+        """Two evals for one job: the second is deferred until ack."""
+        b = make_broker()
+        e1, e2 = ev(job="same"), ev(job="same")
+        b.enqueue(e1)
+        b.enqueue(e2)
+        got1, t1 = b.dequeue(["service"], timeout=1)
+        got_none, _ = b.dequeue(["service"], timeout=0.1)
+        assert got_none is None  # e2 gated behind e1
+        b.ack(got1.id, t1)
+        got2, t2 = b.dequeue(["service"], timeout=1)
+        assert got2 is e2
+        b.ack(got2.id, t2)
+
+    def test_nack_redelivers_after_delay(self):
+        b = make_broker(initial_nack_delay=0.05, nack_delay=0.05)
+        e = ev()
+        b.enqueue(e)
+        got, token = b.dequeue(["service"], timeout=1)
+        b.nack(e.id, token)
+        got_none, _ = b.dequeue(["service"], timeout=0.01)
+        assert got_none is None  # not yet redelivered
+        got2, t2 = b.dequeue(["service"], timeout=1)
+        assert got2.id == e.id
+        b.ack(e.id, t2)
+
+    def test_delivery_limit_routes_to_failed(self):
+        b = make_broker(initial_nack_delay=0.01, nack_delay=0.01, delivery_limit=2)
+        e = ev()
+        b.enqueue(e)
+        for _ in range(2):
+            got, token = b.dequeue(["service"], timeout=1)
+            assert got is not None
+            b.nack(got.id, token)
+        assert b.failed_count() == 1
+        got, _ = b.dequeue(["service"], timeout=0.05)
+        assert got is None
+
+    def test_delivery_limit_releases_deferred_evals(self):
+        """When an eval is routed to _failed, deferred evals for its job
+        must be promoted, not stranded behind a gate that never opens."""
+        b = make_broker(initial_nack_delay=0.01, nack_delay=0.01, delivery_limit=1)
+        e1, e2 = ev(job="same"), ev(job="same")
+        b.enqueue(e1)
+        b.enqueue(e2)
+        got, token = b.dequeue(["service"], timeout=1)
+        b.nack(got.id, token)  # hits delivery limit → _failed
+        assert b.failed_count() == 1
+        got2, t2 = b.dequeue(["service"], timeout=1)
+        assert got2 is not None and got2.id != got.id
+        b.ack(got2.id, t2)
+
+    def test_wait_until_delays_delivery(self):
+        b = make_broker()
+        e = ev()
+        e.wait_until_unix = time.time() + 0.15
+        b.enqueue(e)
+        got, _ = b.dequeue(["service"], timeout=0.05)
+        assert got is None
+        got, t = b.dequeue(["service"], timeout=1)
+        assert got is not None and got.id == e.id
+
+    def test_token_validation(self):
+        b = make_broker()
+        e = ev()
+        b.enqueue(e)
+        _, token = b.dequeue(["service"], timeout=1)
+        import pytest
+
+        with pytest.raises(ValueError):
+            b.ack(e.id, "wrong-token")
+
+
+class TestBlockedEvals:
+    def test_block_and_unblock(self):
+        b = make_broker()
+        blocked = BlockedEvals(broker=b)
+        blocked.set_enabled(True)
+        e = ev(status="blocked")
+        blocked.block(e)
+        assert blocked.blocked_count() == 1
+        released = blocked.unblock()
+        assert released == [e]
+        assert blocked.blocked_count() == 0
+        assert e.status == "pending"
+        got, _ = b.dequeue(["service"], timeout=1)
+        assert got is e
+
+    def test_one_blocked_per_job(self):
+        blocked = BlockedEvals()
+        blocked.set_enabled(True)
+        e1 = ev(status="blocked")
+        e1.modify_index = 5
+        e2 = ev(status="blocked")
+        e2.modify_index = 10
+        blocked.block(e1)
+        blocked.block(e2)
+        assert blocked.blocked_count() == 1
+        assert blocked.get_blocked("default", "j1") is e2
+
+    def test_class_eligibility_gate(self):
+        blocked = BlockedEvals()
+        blocked.set_enabled(True)
+        e = ev(status="blocked")
+        e.class_eligibility = {"class-a": False}
+        e.escaped_computed_class = False
+        blocked.block(e)
+        assert blocked.unblock(computed_class="class-a") == []
+        assert blocked.unblock(computed_class="class-b") == [e]
+
+
+class TestPlanQueue:
+    def test_priority_pop(self):
+        q = PlanQueue()
+        q.set_enabled(True)
+        lo, hi = Plan(priority=10), Plan(priority=90)
+        q.enqueue(lo)
+        q.enqueue(hi)
+        assert q.pop().plan is hi
+        assert q.pop().plan is lo
+
+    def test_disabled_rejects(self):
+        q = PlanQueue()
+        f = q.enqueue(Plan())
+        import pytest
+
+        with pytest.raises(RuntimeError):
+            f.result(timeout=0.1)

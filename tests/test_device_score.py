@@ -1,0 +1,250 @@
+"""Device kernel tests: flattening correctness and score parity against the
+host reference implementations (nomad_tpu.structs.resources), mirroring
+the reference's rank_test.go/feasible_test.go coverage."""
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.device import (
+    PlacementKernel,
+    flatten_cluster,
+    flatten_group_ask,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    ComparableResources,
+    Constraint,
+    Affinity,
+    Spread,
+    SpreadTarget,
+    score_fit_binpack,
+)
+from nomad_tpu.structs.resources import NodeResources
+
+
+def make_store(n_nodes=4, **node_kw):
+    s = StateStore()
+    nodes = []
+    for i in range(n_nodes):
+        nd = mock.node(**node_kw)
+        s.upsert_node(i + 1, nd)
+        nodes.append(nd)
+    return s, nodes
+
+
+class TestFlatten:
+    def test_basic_shapes(self):
+        s, nodes = make_store(5)
+        ct = flatten_cluster(s.snapshot())
+        assert ct.num_nodes == 5
+        assert ct.padded_n == 8  # bucketed
+        assert ct.capacity.shape == (8, 4)
+        assert not ct.ready[5:].any()  # padding rows never ready
+        # reserved-adjusted capacity: 4000-100 cpu
+        assert ct.capacity[0, 0] == 3900.0
+
+    def test_usage_sums_nonterminal(self):
+        s, nodes = make_store(2)
+        j = mock.job()
+        live = mock.alloc(j, nodes[0])
+        dead = mock.alloc(j, nodes[0], client_status="complete")
+        s.upsert_allocs(10, [live, dead])
+        ct = flatten_cluster(s.snapshot())
+        row = ct.row_of(nodes[0].id)
+        assert ct.used[row, 0] == 500.0  # one live web task
+        assert ct.used[1 - row, 0] == 0.0
+
+    def test_dc_mask(self):
+        s = StateStore()
+        a = mock.node(datacenter="dc1")
+        b = mock.node(datacenter="dc2")
+        s.upsert_node(1, a)
+        s.upsert_node(2, b)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job(datacenters=["dc2"])
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 1)
+        assert ga.eligible[ct.row_of(b.id)]
+        assert not ga.eligible[ct.row_of(a.id)]
+
+    def test_constraint_mask_class_memoized(self):
+        s = StateStore()
+        lin = mock.node()
+        win = mock.node(attributes={"kernel.name": "windows", "arch": "x86"},
+                        drivers={"exec": True})
+        s.upsert_node(1, lin)
+        s.upsert_node(2, win)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job(constraints=[
+            Constraint(l_target="${attr.kernel.name}", r_target="linux", operand="=")
+        ])
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 1)
+        assert ga.eligible[ct.row_of(lin.id)]
+        assert not ga.eligible[ct.row_of(win.id)]
+
+    def test_driver_health_filters(self):
+        s = StateStore()
+        good = mock.node()
+        bad = mock.node(drivers={"exec": False})
+        s.upsert_node(1, good)
+        s.upsert_node(2, bad)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job()
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 1)
+        assert ga.eligible[ct.row_of(good.id)]
+        assert not ga.eligible[ct.row_of(bad.id)]
+
+
+class TestPlacementKernel:
+    def test_binpack_prefers_filled_node(self):
+        """BestFit: with one node partially used, new allocs pack onto it."""
+        s, nodes = make_store(3)
+        j0 = mock.job()
+        s.upsert_allocs(10, [mock.alloc(j0, nodes[1])])
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job()
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 1)
+        res = PlacementKernel().place(ct, [ga])[0]
+        assert res.node_rows[0] == ct.row_of(nodes[1].id)
+
+    def test_score_matches_host_reference(self):
+        """Device binpack score must equal the host score_fit_binpack."""
+        s, nodes = make_store(2)
+        j0 = mock.job()
+        s.upsert_allocs(5, [mock.alloc(j0, nodes[0])])
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job(id="fresh-job")
+        tg = j.task_groups[0]
+        ga = flatten_group_ask(ct, snap, j, tg, 1)
+        res = PlacementKernel().place(ct, [ga])[0]
+        row = res.node_rows[0]
+        node = nodes[0] if row == ct.row_of(nodes[0].id) else nodes[1]
+        ask = tg.combined_resources()
+        used = ComparableResources(
+            cpu=int(ct.used[row, 0]) + ask.cpu,
+            memory_mb=int(ct.used[row, 1]) + ask.memory_mb,
+        )
+        expected = score_fit_binpack(node, used) / 18.0
+        assert res.scores[0] == pytest.approx(expected, abs=1e-4)
+
+    def test_sequential_usage_accumulates(self):
+        """Placing count=N accounts each prior placement (ProposedAllocs
+        semantics): a node fills up and placement moves on."""
+        s, nodes = make_store(2, node_resources=NodeResources(cpu=1200, memory_mb=1024))
+        # mock reserved: 100 cpu / 256 mem ⇒ capacity 1100 cpu, 768 mem
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job()  # web: 500 cpu / 256 mem + 300 disk
+        j.task_groups[0].count = 4
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 4)
+        res = PlacementKernel().place(ct, [ga])[0]
+        # each node fits 2 (cpu: 2*500 <= 1100, 3rd would exceed)
+        placed = [r for r in res.node_rows if r >= 0]
+        assert len(placed) == 4
+        counts = np.bincount(placed, minlength=2)
+        assert sorted(counts[:2].tolist()) == [2, 2]
+
+    def test_infeasible_returns_minus_one(self):
+        s, nodes = make_store(1, node_resources=NodeResources(cpu=200, memory_mb=300))
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job()  # asks 500 cpu > capacity
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 2)
+        res = PlacementKernel().place(ct, [ga])[0]
+        assert list(res.node_rows) == [-1, -1]
+
+    def test_anti_affinity_spreads_same_job(self):
+        """JobAntiAffinity (rank.go:536-604): same-job allocs repel, so 2
+        placements land on 2 different nodes even though binpack alone
+        would stack them."""
+        s, nodes = make_store(2)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job()
+        j.task_groups[0].count = 2
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 2)
+        res = PlacementKernel().place(ct, [ga])[0]
+        assert res.node_rows[0] != res.node_rows[1]
+
+    def test_distinct_hosts(self):
+        s, nodes = make_store(3)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job(constraints=[Constraint(operand="distinct_hosts")])
+        j.task_groups[0].count = 4
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 4)
+        res = PlacementKernel().place(ct, [ga])[0]
+        placed = [r for r in res.node_rows if r >= 0]
+        assert len(placed) == 3  # only 3 hosts
+        assert len(set(placed)) == 3
+        assert res.node_rows[3] == -1
+
+    def test_reschedule_penalty_avoids_previous_node(self):
+        s, nodes = make_store(2)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job()
+        ga = flatten_group_ask(
+            ct, snap, j, j.task_groups[0], 1,
+            penalty_node_ids={nodes[0].id},
+        )
+        res = PlacementKernel().place(ct, [ga])[0]
+        assert res.node_rows[0] == ct.row_of(nodes[1].id)
+
+    def test_affinity_attracts(self):
+        s = StateStore()
+        plain = mock.node()
+        ssd = mock.node(attributes={**plain.attributes, "storage.type": "ssd"})
+        s.upsert_node(1, plain)
+        s.upsert_node(2, ssd)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job(affinities=[
+            Affinity(l_target="${attr.storage.type}", r_target="ssd",
+                     operand="=", weight=100)
+        ])
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 1)
+        res = PlacementKernel().place(ct, [ga])[0]
+        assert res.node_rows[0] == ct.row_of(ssd.id)
+
+    def test_spread_by_rack(self):
+        """Spread over meta.rack with 50/50 targets → balanced placement."""
+        s = StateStore()
+        racks = []
+        for i, rack in enumerate(["r1", "r1", "r2", "r2"]):
+            nd = mock.node(meta={"rack": rack})
+            s.upsert_node(i + 1, nd)
+            racks.append((nd, rack))
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        j = mock.job(spreads=[
+            Spread(attribute="${meta.rack}", weight=100,
+                   targets=[SpreadTarget("r1", 50), SpreadTarget("r2", 50)])
+        ])
+        j.task_groups[0].count = 4
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 4)
+        res = PlacementKernel().place(ct, [ga])[0]
+        by_rack = {"r1": 0, "r2": 0}
+        for row in res.node_rows:
+            nd = [n for n, _ in racks if ct.row_of(n.id) == row][0]
+            by_rack[nd.meta["rack"]] += 1
+        assert by_rack == {"r1": 2, "r2": 2}
+
+    def test_batch_independent_groups(self):
+        """Batched groups score against the same snapshot (optimistic)."""
+        s, nodes = make_store(4)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        jobs = [mock.job() for _ in range(3)]
+        asks = [
+            flatten_group_ask(ct, snap, j, j.task_groups[0], 2) for j in jobs
+        ]
+        results = PlacementKernel().place(ct, asks)
+        assert len(results) == 3
+        for r in results:
+            assert all(row >= 0 for row in r.node_rows)

@@ -1,0 +1,236 @@
+"""GenericScheduler end-to-end tests through the Harness — the analog of
+scheduler/generic_sched_test.go (register, scale, update, node-down,
+failed placements → blocked evals) driving the real state store, device
+kernel, and plan-apply verification."""
+
+import copy
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    ALLOC_CLIENT_LOST,
+    ALLOC_DESIRED_STOP,
+    EVAL_STATUS_COMPLETE,
+    NODE_STATUS_DOWN,
+)
+from nomad_tpu.structs.resources import NodeResources
+
+
+def setup_cluster(n_nodes=3):
+    h = Harness()
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for i, n in enumerate(nodes):
+        h.store.upsert_node(i + 1, n)
+    return h, nodes
+
+
+def register_and_run(h, job):
+    h.store.upsert_job(h.next_index(), job)
+    ev = mock.eval_for(job)
+    h.store.upsert_evals(h.next_index(), [ev])
+    h.process(ev)
+    return ev
+
+
+class TestJobRegister:
+    def test_places_all_allocs(self):
+        h, nodes = setup_cluster(3)
+        job = mock.job()  # count=10
+        register_and_run(h, job)
+
+        assert len(h.plans) == 1
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        assert len(allocs) == 10
+        # all running nodes, names dense [0..9]
+        assert sorted(a.index() for a in allocs) == list(range(10))
+        assert all(a.node_id in {n.id for n in nodes} for a in allocs)
+        # eval marked complete
+        assert h.evals[-1].status == EVAL_STATUS_COMPLETE
+        assert not h.created_evals
+
+    def test_alloc_metrics_recorded(self):
+        h, _ = setup_cluster(2)
+        job = mock.job()
+        register_and_run(h, job)
+        a = h.store.allocs_by_job(job.namespace, job.id)[0]
+        assert a.metrics.nodes_evaluated == 2
+        assert a.metrics.scores
+
+    def test_noop_second_eval(self):
+        h, _ = setup_cluster(2)
+        job = mock.job()
+        register_and_run(h, job)
+        n_plans = len(h.plans)
+        ev2 = mock.eval_for(job)
+        h.process(ev2)
+        # reconciler finds nothing to do ⇒ no new committed plan results
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        assert len(allocs) == 10
+        assert len(h.plans) <= n_plans + 1  # a no-op plan is not submitted
+
+    def test_failed_placement_creates_blocked_eval(self):
+        h, _ = setup_cluster(1)
+        # node capacity (minus reserved) fits only a few 500MHz tasks
+        job = mock.job()
+        job.task_groups[0].count = 30
+        register_and_run(h, job)
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        assert 0 < len(allocs) < 30
+        # blocked eval created for the remainder
+        assert len(h.created_evals) == 1
+        blocked = h.created_evals[0]
+        assert blocked.status == "blocked"
+        assert blocked.previous_eval
+        assert "web" in h.evals[-1].failed_tg_allocs
+
+
+class TestJobUpdate:
+    def test_scale_up(self):
+        h, _ = setup_cluster(3)
+        job = mock.job()
+        register_and_run(h, job)
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].count = 15
+        register_and_run(h, j2)
+        live = [
+            a
+            for a in h.store.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        ]
+        assert len(live) == 15
+
+    def test_scale_down_stops_highest_indices(self):
+        h, _ = setup_cluster(3)
+        job = mock.job()
+        register_and_run(h, job)
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].count = 4
+        register_and_run(h, j2)
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        live = [a for a in allocs if not a.terminal_status()]
+        stopped = [a for a in allocs if a.desired_status == ALLOC_DESIRED_STOP]
+        assert len(live) == 4
+        assert len(stopped) == 6
+        assert sorted(a.index() for a in live) == [0, 1, 2, 3]
+
+    def test_destructive_update_replaces(self):
+        h, _ = setup_cluster(3)
+        job = mock.job()
+        register_and_run(h, job)
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].resources.cpu = 600  # destructive
+        register_and_run(h, j2)
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        live = [a for a in allocs if not a.terminal_status()]
+        assert len(live) == 10
+        assert all(a.job_version == j2.version for a in live)
+        assert all(a.resources.cpu == 600 for a in live)
+        stopped = [a for a in allocs if a.desired_status == ALLOC_DESIRED_STOP]
+        assert len(stopped) == 10
+
+    def test_inplace_update_keeps_nodes(self):
+        h, _ = setup_cluster(3)
+        job = mock.job()
+        register_and_run(h, job)
+        before = {
+            a.id: a.node_id for a in h.store.allocs_by_job(job.namespace, job.id)
+        }
+        j2 = copy.deepcopy(job)
+        j2.meta = {"foo": "bar"}  # non-destructive change
+        register_and_run(h, j2)
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        live = [a for a in allocs if not a.terminal_status()]
+        assert len(live) == 10
+        # same alloc ids, same nodes — updated in place
+        assert {a.id: a.node_id for a in live} == before
+        assert all(a.job_version == j2.version for a in live)
+
+    def test_job_stop_stops_everything(self):
+        h, _ = setup_cluster(3)
+        job = mock.job()
+        register_and_run(h, job)
+        j2 = copy.deepcopy(job)
+        j2.stop = True
+        register_and_run(h, j2)
+        live = [
+            a
+            for a in h.store.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        ]
+        assert live == []
+
+
+class TestNodeFailure:
+    def test_node_down_reschedules(self):
+        h, nodes = setup_cluster(3)
+        job = mock.job()
+        register_and_run(h, job)
+        victims = h.store.allocs_by_node(nodes[0].id)
+        assert victims  # binpack stacked some allocs here
+        h.store.update_node_status(h.next_index(), nodes[0].id, NODE_STATUS_DOWN)
+
+        ev = mock.eval_for(job, triggered_by="node-update", node_id=nodes[0].id)
+        h.process(ev)
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        live = [a for a in allocs if not a.terminal_status()]
+        assert len(live) == 10
+        assert all(a.node_id != nodes[0].id for a in live)
+        lost = [a for a in allocs if a.client_status == ALLOC_CLIENT_LOST]
+        assert len(lost) == len(victims)
+        # replacements chain back to their previous allocation
+        replacement_prevs = {a.previous_allocation for a in live} - {""}
+        assert replacement_prevs == {a.id for a in victims}
+
+
+class TestSystemScheduler:
+    def test_places_on_every_feasible_node(self):
+        h, nodes = setup_cluster(4)
+        job = mock.system_job()
+        h.store.upsert_job(h.next_index(), job)
+        ev = mock.eval_for(job)
+        h.process(ev)
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        assert len(allocs) == 4
+        assert {a.node_id for a in allocs} == {n.id for n in nodes}
+
+    def test_new_node_gets_system_alloc(self):
+        h, nodes = setup_cluster(2)
+        job = mock.system_job()
+        h.store.upsert_job(h.next_index(), job)
+        h.process(mock.eval_for(job))
+        new_node = mock.node()
+        h.store.upsert_node(h.next_index(), new_node)
+        h.process(mock.eval_for(job, triggered_by="node-update"))
+        allocs = [
+            a
+            for a in h.store.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        ]
+        assert len(allocs) == 3
+        assert new_node.id in {a.node_id for a in allocs}
+
+
+class TestPlanRejection:
+    def test_partial_commit_retries(self):
+        """Force one rejection; scheduler must retry and converge
+        (the RefreshIndex feedback loop, plan_apply.go:576-594)."""
+        h, _ = setup_cluster(3)
+        calls = {"n": 0}
+
+        def reject_once(plan):
+            calls["n"] += 1
+            return calls["n"] == 1
+
+        h.reject_plan = reject_once
+        job = mock.job()
+        register_and_run(h, job)
+        live = [
+            a
+            for a in h.store.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        ]
+        assert len(live) == 10
+        assert calls["n"] >= 2

@@ -1,0 +1,142 @@
+"""StateStore tests: snapshot isolation, indexes, plan-result apply.
+
+Mirrors nomad/state/state_store_test.go patterns (upsert/read-back,
+snapshot independence, watch barriers)."""
+
+import threading
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import ALLOC_DESIRED_STOP, PlanResult
+
+
+def test_upsert_and_read_node():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(10, n)
+    got = s.node_by_id(n.id)
+    assert got is n
+    assert got.create_index == 10 and got.modify_index == 10
+    assert s.latest_index == 10
+
+
+def test_snapshot_isolation():
+    s = StateStore()
+    n1 = mock.node()
+    s.upsert_node(1, n1)
+    snap = s.snapshot()
+    n2 = mock.node()
+    s.upsert_node(2, n2)
+    # snapshot does not see the new node; live store does
+    assert snap.node_by_id(n2.id) is None
+    assert len(list(snap.nodes())) == 1
+    assert len(list(s.nodes())) == 2
+    assert snap.index == 1
+
+
+def test_snapshot_isolation_status_update():
+    s = StateStore()
+    n = mock.node()
+    s.upsert_node(1, n)
+    snap = s.snapshot()
+    s.update_node_status(2, n.id, "down")
+    assert snap.node_by_id(n.id).status == "ready"
+    assert s.node_by_id(n.id).status == "down"
+
+
+def test_job_versioning():
+    s = StateStore()
+    j = mock.job()
+    s.upsert_job(1, j)
+    assert j.version == 0
+    import copy
+
+    j2 = copy.deepcopy(j)
+    s.upsert_job(2, j2)
+    assert j2.version == 1
+    assert s.job_by_id(j.namespace, j.id).version == 1
+    assert s.job_version(j.namespace, j.id, 0) is not None
+
+
+def test_alloc_indexes():
+    s = StateStore()
+    n = mock.node()
+    j = mock.job()
+    s.upsert_node(1, n)
+    s.upsert_job(2, j)
+    allocs = [mock.alloc(j, n) for _ in range(3)]
+    s.upsert_allocs(3, allocs)
+    assert len(s.allocs_by_node(n.id)) == 3
+    assert len(s.allocs_by_job(j.namespace, j.id)) == 3
+    assert s.alloc_by_id(allocs[0].id) is allocs[0]
+    # terminal filtering
+    allocs[0].client_status = "complete"
+    assert len(s.allocs_by_node_terminal(n.id, False)) == 2
+
+
+def test_evals_by_job_index():
+    s = StateStore()
+    j = mock.job()
+    e1, e2 = mock.eval_for(j), mock.eval_for(j)
+    s.upsert_evals(5, [e1, e2])
+    assert {e.id for e in s.evals_by_job(j.namespace, j.id)} == {e1.id, e2.id}
+    s.delete_evals(6, [e1.id])
+    assert {e.id for e in s.evals_by_job(j.namespace, j.id)} == {e2.id}
+
+
+def test_wait_for_index_blocks_until_write():
+    s = StateStore()
+    result = {}
+
+    def waiter():
+        result["ok"] = s.wait_for_index(5, timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    s.upsert_node(5, mock.node())
+    t.join(timeout=5)
+    assert result["ok"] is True
+    assert s.wait_for_index(99, timeout=0.05) is False
+
+
+def test_upsert_plan_results():
+    s = StateStore()
+    n = mock.node()
+    j = mock.job()
+    s.upsert_node(1, n)
+    s.upsert_job(2, j)
+    old = mock.alloc(j, n)
+    s.upsert_allocs(3, [old])
+    stopped = old.copy_for_update()
+    stopped.desired_status = ALLOC_DESIRED_STOP
+    new = mock.alloc(j, n)
+    result = PlanResult(
+        node_update={n.id: [stopped]},
+        node_allocation={n.id: [new]},
+        alloc_index=4,
+    )
+    s.upsert_plan_results(4, result)
+    assert s.alloc_by_id(old.id).desired_status == ALLOC_DESIRED_STOP
+    assert s.alloc_by_id(new.id) is new
+    assert s.alloc_by_id(old.id).create_index == 3  # preserved
+    assert s.alloc_by_id(new.id).create_index == 4
+
+
+def test_listener_fires():
+    s = StateStore()
+    seen = []
+    s.add_listener(lambda table, idx: seen.append((table, idx)))
+    s.upsert_node(1, mock.node())
+    assert ("nodes", 1) in seen
+
+
+def test_node_update_preserves_snapshot_under_many_writes():
+    s = StateStore()
+    nodes = [mock.node() for _ in range(50)]
+    for i, n in enumerate(nodes):
+        s.upsert_node(i + 1, n)
+    snap = s.snapshot()
+    for i, n in enumerate(nodes):
+        s.update_node_status(100 + i, n.id, "down")
+    assert all(n.status == "ready" for n in snap.nodes())
+    assert all(n.status == "down" for n in s.nodes())
