@@ -1,0 +1,52 @@
+"""Agent — the single-binary composition of server and/or client.
+
+Reference: command/agent/agent.go (:709 setupServer, :884 setupClient);
+``nomad agent -dev`` runs both in one process with an in-process RPC link,
+which is exactly what DevAgent does here.
+"""
+
+from __future__ import annotations
+
+import tempfile
+from typing import Optional
+
+from .client import Client
+from .server import Server, ServerConfig
+
+
+class DevAgent:
+    """Server + client in one process (the `-dev` mode)."""
+
+    def __init__(
+        self,
+        data_dir: Optional[str] = None,
+        num_workers: int = 2,
+        heartbeat_ttl: float = 5.0,
+        node=None,
+    ):
+        self.data_dir = data_dir or tempfile.mkdtemp(prefix="nomad-tpu-dev-")
+        self.server = Server(
+            ServerConfig(num_workers=num_workers, heartbeat_ttl=heartbeat_ttl)
+        )
+        self.client = Client(
+            rpc=self.server.client_rpc(), data_dir=self.data_dir, node=node
+        )
+
+    def start(self) -> None:
+        self.server.establish_leadership()
+        self.client.start()
+
+    def shutdown(self) -> None:
+        self.client.shutdown()
+        self.server.shutdown()
+
+    # convenience passthroughs
+    def register_job(self, job):
+        return self.server.register_job(job)
+
+    def deregister_job(self, namespace: str, job_id: str):
+        return self.server.deregister_job(namespace, job_id)
+
+    @property
+    def store(self):
+        return self.server.store

@@ -1,0 +1,7 @@
+"""L6/L7 API surface: HTTP agent, JSON codec, Python SDK."""
+
+from .client import APIException, NomadClient
+from .codec import decode_job, decode_node, encode
+from .http import HTTPAgent
+
+__all__ = ["HTTPAgent", "NomadClient", "APIException", "encode", "decode_job", "decode_node"]

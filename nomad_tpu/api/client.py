@@ -1,0 +1,188 @@
+"""Python SDK — the api/ package analog.
+
+Reference: api/ (19.5k LoC standalone Go module mirroring every HTTP
+endpoint: api.go, jobs.go, nodes.go, allocations.go, evaluations.go,
+operator.go). Stdlib urllib transport; one class per noun, hung off
+``NomadClient`` exactly like api.Client's accessors."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+
+class APIException(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class NomadClient:
+    def __init__(self, address: str = "http://127.0.0.1:4646", timeout: float = 10.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    # -- transport ---------------------------------------------------------
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Any = None,
+        params: Optional[dict] = None,
+    ):
+        url = self.address + path
+        if params:
+            from urllib.parse import urlencode
+
+            url += "?" + urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            raise APIException(e.code, msg) from None
+
+    def get(self, path, **params):
+        return self._request("GET", path, params=params or None)
+
+    def post(self, path, body=None, **params):
+        return self._request("POST", path, body=body, params=params or None)
+
+    def delete(self, path, **params):
+        return self._request("DELETE", path, params=params or None)
+
+    # -- nouns -------------------------------------------------------------
+    @property
+    def jobs(self) -> "Jobs":
+        return Jobs(self)
+
+    @property
+    def nodes(self) -> "Nodes":
+        return Nodes(self)
+
+    @property
+    def allocations(self) -> "Allocations":
+        return Allocations(self)
+
+    @property
+    def evaluations(self) -> "Evaluations":
+        return Evaluations(self)
+
+    @property
+    def operator(self) -> "Operator":
+        return Operator(self)
+
+    @property
+    def agent(self) -> "Agent":
+        return Agent(self)
+
+
+class Jobs:
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def list(self):
+        return self.c.get("/v1/jobs")
+
+    def register(self, job_dict: dict):
+        return self.c.post("/v1/jobs", {"job": job_dict})
+
+    def plan(self, job_dict: dict):
+        return self.c.post(f"/v1/job/{job_dict['id']}/plan", {"job": job_dict})
+
+    def info(self, job_id: str, namespace: str = "default"):
+        return self.c.get(f"/v1/job/{job_id}", namespace=namespace)
+
+    def deregister(self, job_id: str, namespace: str = "default"):
+        return self.c.delete(f"/v1/job/{job_id}", namespace=namespace)
+
+    def allocations(self, job_id: str, namespace: str = "default"):
+        return self.c.get(f"/v1/job/{job_id}/allocations", namespace=namespace)
+
+    def evaluations(self, job_id: str, namespace: str = "default"):
+        return self.c.get(f"/v1/job/{job_id}/evaluations", namespace=namespace)
+
+    def summary(self, job_id: str, namespace: str = "default"):
+        return self.c.get(f"/v1/job/{job_id}/summary", namespace=namespace)
+
+
+class Nodes:
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def list(self):
+        return self.c.get("/v1/nodes")
+
+    def info(self, node_id: str):
+        return self.c.get(f"/v1/node/{node_id}")
+
+    def drain(self, node_id: str, enabled: bool = True, deadline_s: float = 3600):
+        return self.c.post(
+            f"/v1/node/{node_id}/drain",
+            {"drain_enabled": enabled, "deadline_s": deadline_s},
+        )
+
+    def eligibility(self, node_id: str, eligible: bool):
+        return self.c.post(
+            f"/v1/node/{node_id}/eligibility",
+            {"eligibility": "eligible" if eligible else "ineligible"},
+        )
+
+    def allocations(self, node_id: str):
+        return self.c.get(f"/v1/node/{node_id}/allocations")
+
+
+class Allocations:
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def list(self):
+        return self.c.get("/v1/allocations")
+
+    def info(self, alloc_id: str):
+        return self.c.get(f"/v1/allocation/{alloc_id}")
+
+
+class Evaluations:
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def list(self):
+        return self.c.get("/v1/evaluations")
+
+    def info(self, eval_id: str):
+        return self.c.get(f"/v1/evaluation/{eval_id}")
+
+
+class Operator:
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def scheduler_config(self):
+        return self.c.get("/v1/operator/scheduler/configuration")
+
+    def set_scheduler_config(self, **kwargs):
+        return self.c.post("/v1/operator/scheduler/configuration", kwargs)
+
+
+class Agent:
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def self(self):
+        return self.c.get("/v1/agent/self")
+
+    def metrics(self):
+        return self.c.get("/v1/metrics")

@@ -1,0 +1,452 @@
+"""HTTP API agent.
+
+Reference: command/agent/http.go (:275-360 route table). The `/v1/...`
+REST surface over the server, stdlib-only (ThreadingHTTPServer): jobs
+(register/list/read/plan/evals/allocs/deregister), nodes (list/read/
+drain/eligibility), allocations, evaluations, operator scheduler config
+(the seam the TPU algorithm is toggled through,
+nomad/structs/operator.go:128-169), agent self, and metrics.
+
+Blocking queries: ``?index=N&wait=S`` holds the request until the state
+store passes index N (the memdb WatchSet analog, state_store.go blocking
+queries); every response carries ``X-Nomad-Index``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..structs import Evaluation, new_id
+from ..structs.job import JOB_DEFAULT_PRIORITY
+from .codec import decode_job, encode
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class HTTPAgent:
+    """Routes + handlers bound to a Server (and optionally a Client)."""
+
+    def __init__(self, server, client=None, host="127.0.0.1", port=4646):
+        self.server = server
+        self.client = client
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.routes = [
+            (re.compile(r"^/v1/jobs$"), self.handle_jobs),
+            (re.compile(r"^/v1/job/(?P<job_id>[^/]+)$"), self.handle_job),
+            (re.compile(r"^/v1/job/(?P<job_id>[^/]+)/plan$"), self.handle_job_plan),
+            (
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/evaluations$"),
+                self.handle_job_evals,
+            ),
+            (
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/allocations$"),
+                self.handle_job_allocs,
+            ),
+            (
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/summary$"),
+                self.handle_job_summary,
+            ),
+            (re.compile(r"^/v1/nodes$"), self.handle_nodes),
+            (re.compile(r"^/v1/node/(?P<node_id>[^/]+)$"), self.handle_node),
+            (
+                re.compile(r"^/v1/node/(?P<node_id>[^/]+)/drain$"),
+                self.handle_node_drain,
+            ),
+            (
+                re.compile(r"^/v1/node/(?P<node_id>[^/]+)/eligibility$"),
+                self.handle_node_eligibility,
+            ),
+            (
+                re.compile(r"^/v1/node/(?P<node_id>[^/]+)/allocations$"),
+                self.handle_node_allocs,
+            ),
+            (re.compile(r"^/v1/allocations$"), self.handle_allocs),
+            (
+                re.compile(r"^/v1/allocation/(?P<alloc_id>[^/]+)$"),
+                self.handle_alloc,
+            ),
+            (re.compile(r"^/v1/evaluations$"), self.handle_evals),
+            (
+                re.compile(r"^/v1/evaluation/(?P<eval_id>[^/]+)$"),
+                self.handle_eval,
+            ),
+            (
+                re.compile(r"^/v1/operator/scheduler/configuration$"),
+                self.handle_scheduler_config,
+            ),
+            (re.compile(r"^/v1/agent/self$"), self.handle_agent_self),
+            (re.compile(r"^/v1/status/leader$"), self.handle_leader),
+            (re.compile(r"^/v1/metrics$"), self.handle_metrics),
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        agent = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # silence
+                pass
+
+            def _dispatch(self, method):
+                parsed = urlparse(self.path)
+                query = {
+                    k: v[0] for k, v in parse_qs(parsed.query).items()
+                }
+                body = None
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    raw = self.rfile.read(length)
+                    try:
+                        body = json.loads(raw)
+                    except json.JSONDecodeError:
+                        self._reply(400, {"error": "invalid JSON body"})
+                        return
+                for pattern, handler in agent.routes:
+                    m = pattern.match(parsed.path)
+                    if m:
+                        try:
+                            result = handler(
+                                method, body, query, **m.groupdict()
+                            )
+                        except APIError as e:
+                            self._reply(e.status, {"error": e.message})
+                        except Exception as e:  # noqa: BLE001
+                            self._reply(500, {"error": str(e)})
+                        else:
+                            self._reply(200, result)
+                        return
+                self._reply(404, {"error": f"no handler for {parsed.path}"})
+
+            def _reply(self, status, payload):
+                data = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header(
+                    "X-Nomad-Index", str(agent.server.store.latest_index)
+                )
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-agent", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- blocking-query helper --------------------------------------------
+    def _maybe_block(self, query) -> None:
+        index = int(query.get("index", 0) or 0)
+        if index:
+            wait = float(query.get("wait", 5.0) or 5.0)
+            self.server.store.wait_for_index(index + 1, timeout=wait)
+
+    # -- handlers ----------------------------------------------------------
+    def handle_jobs(self, method, body, query):
+        if method == "GET":
+            self._maybe_block(query)
+            return [
+                {
+                    "id": j.id,
+                    "name": j.name,
+                    "namespace": j.namespace,
+                    "type": j.type,
+                    "priority": j.priority,
+                    "status": j.status,
+                    "stop": j.stop,
+                    "version": j.version,
+                    "modify_index": j.modify_index,
+                }
+                for j in self.server.store.jobs()
+            ]
+        if method in ("POST", "PUT"):
+            payload = body.get("job") if isinstance(body, dict) else None
+            if payload is None:
+                raise APIError(400, "missing 'job' in body")
+            job = decode_job(payload)
+            if not job.id:
+                raise APIError(400, "job id is required")
+            if not job.task_groups:
+                raise APIError(400, "job needs at least one task group")
+            job.priority = job.priority or JOB_DEFAULT_PRIORITY
+            ev = self.server.register_job(job)
+            return {"eval_id": ev.id, "job_modify_index": job.modify_index}
+        raise APIError(405, f"method {method} not allowed")
+
+    def _get_job(self, job_id, query):
+        ns = query.get("namespace", "default")
+        job = self.server.store.job_by_id(ns, job_id)
+        if job is None:
+            raise APIError(404, f"job {job_id} not found")
+        return job
+
+    def handle_job(self, method, body, query, job_id):
+        if method == "GET":
+            self._maybe_block(query)
+            return encode(self._get_job(job_id, query))
+        if method == "DELETE":
+            job = self._get_job(job_id, query)
+            ev = self.server.deregister_job(job.namespace, job.id)
+            return {"eval_id": ev.id if ev else ""}
+        raise APIError(405, f"method {method} not allowed")
+
+    def handle_job_plan(self, method, body, query, job_id):
+        """Dry-run: run the scheduler inline on a snapshot without
+        submitting the plan (SURVEY.md §3.3, nomad/job_endpoint Job.Plan)."""
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        payload = body.get("job") if isinstance(body, dict) else None
+        if payload is None:
+            raise APIError(400, "missing 'job' in body")
+        job = decode_job(payload)
+        from ..scheduler.annotate import plan_job
+
+        return plan_job(self.server.store, job)
+
+    def handle_job_evals(self, method, body, query, job_id):
+        job = self._get_job(job_id, query)
+        return [encode(e) for e in self.server.store.evals_by_job(job.namespace, job.id)]
+
+    def handle_job_allocs(self, method, body, query, job_id):
+        job = self._get_job(job_id, query)
+        self._maybe_block(query)
+        return [
+            encode(a)
+            for a in self.server.store.allocs_by_job(job.namespace, job.id)
+        ]
+
+    def handle_job_summary(self, method, body, query, job_id):
+        job = self._get_job(job_id, query)
+        allocs = self.server.store.allocs_by_job(job.namespace, job.id)
+        summary: dict[str, dict[str, int]] = {}
+        for tg in job.task_groups:
+            summary[tg.name] = {
+                "queued": 0, "starting": 0, "running": 0,
+                "complete": 0, "failed": 0, "lost": 0,
+            }
+        for a in allocs:
+            s = summary.setdefault(a.task_group, {})
+            key = {
+                "pending": "starting",
+                "running": "running",
+                "complete": "complete",
+                "failed": "failed",
+                "lost": "lost",
+            }.get(a.client_status, "starting")
+            if a.desired_status == "run" or a.client_terminal_status():
+                s[key] = s.get(key, 0) + 1
+        for ev in self.server.store.evals_by_job(job.namespace, job.id):
+            for tg, n in ev.queued_allocations.items():
+                if tg in summary:
+                    summary[tg]["queued"] = max(summary[tg]["queued"], n)
+        return {"job_id": job.id, "summary": summary}
+
+    def handle_nodes(self, method, body, query):
+        self._maybe_block(query)
+        return [
+            {
+                "id": n.id,
+                "name": n.name,
+                "datacenter": n.datacenter,
+                "node_class": n.node_class,
+                "status": n.status,
+                "scheduling_eligibility": n.scheduling_eligibility,
+                "drain": n.drain is not None,
+                "modify_index": n.modify_index,
+            }
+            for n in self.server.store.nodes()
+        ]
+
+    def _get_node(self, node_id):
+        node = self.server.store.node_by_id(node_id)
+        if node is None:
+            # prefix match convenience (CLI-style short ids)
+            matches = [
+                n for n in self.server.store.nodes() if n.id.startswith(node_id)
+            ]
+            if len(matches) == 1:
+                return matches[0]
+            raise APIError(404, f"node {node_id} not found")
+        return node
+
+    def handle_node(self, method, body, query, node_id):
+        return encode(self._get_node(node_id))
+
+    def handle_node_drain(self, method, body, query, node_id):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        node = self._get_node(node_id)
+        from ..structs import DrainStrategy
+
+        enable = bool(body.get("drain_enabled", True)) if body else True
+        drain = (
+            DrainStrategy(deadline_s=float(body.get("deadline_s", 3600)))
+            if enable
+            else None
+        )
+        evals = self.server.update_node_drain(node.id, drain)
+        return {"eval_ids": [e.id for e in evals]}
+
+    def handle_node_eligibility(self, method, body, query, node_id):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        node = self._get_node(node_id)
+        elig = body.get("eligibility") if body else None
+        if elig not in ("eligible", "ineligible"):
+            raise APIError(400, "eligibility must be eligible|ineligible")
+        self.server._raft_apply(
+            lambda index: self.server.store.update_node_eligibility(
+                index, node.id, elig
+            )
+        )
+        return {"eligibility": elig}
+
+    def handle_node_allocs(self, method, body, query, node_id):
+        node = self._get_node(node_id)
+        return [encode(a) for a in self.server.store.allocs_by_node(node.id)]
+
+    def handle_allocs(self, method, body, query):
+        self._maybe_block(query)
+        return [
+            {
+                "id": a.id,
+                "eval_id": a.eval_id,
+                "name": a.name,
+                "node_id": a.node_id,
+                "job_id": a.job_id,
+                "task_group": a.task_group,
+                "desired_status": a.desired_status,
+                "client_status": a.client_status,
+                "modify_index": a.modify_index,
+            }
+            for a in self.server.store.allocs()
+        ]
+
+    def handle_alloc(self, method, body, query, alloc_id):
+        a = self.server.store.alloc_by_id(alloc_id)
+        if a is None:
+            matches = [
+                x for x in self.server.store.allocs() if x.id.startswith(alloc_id)
+            ]
+            if len(matches) != 1:
+                raise APIError(404, f"alloc {alloc_id} not found")
+            a = matches[0]
+        return encode(a)
+
+    def handle_evals(self, method, body, query):
+        self._maybe_block(query)
+        return [encode(e) for e in self.server.store.evals()]
+
+    def handle_eval(self, method, body, query, eval_id):
+        e = self.server.store.eval_by_id(eval_id)
+        if e is None:
+            raise APIError(404, f"eval {eval_id} not found")
+        return encode(e)
+
+    def handle_scheduler_config(self, method, body, query):
+        cfg = self.server.store.scheduler_config()
+        if method == "GET":
+            return {
+                "scheduler_algorithm": cfg.scheduler_algorithm,
+                "preemption_config": {
+                    "system_scheduler_enabled": cfg.preemption_system_enabled,
+                    "batch_scheduler_enabled": cfg.preemption_batch_enabled,
+                    "service_scheduler_enabled": cfg.preemption_service_enabled,
+                },
+                "memory_oversubscription_enabled": cfg.memory_oversubscription_enabled,
+                "pause_eval_broker": cfg.pause_eval_broker,
+            }
+        if method in ("POST", "PUT"):
+            if not body:
+                raise APIError(400, "missing body")
+            from ..state import SchedulerConfiguration
+
+            pc = body.get("preemption_config", {})
+            new_cfg = SchedulerConfiguration(
+                scheduler_algorithm=body.get(
+                    "scheduler_algorithm", cfg.scheduler_algorithm
+                ),
+                preemption_system_enabled=pc.get(
+                    "system_scheduler_enabled", cfg.preemption_system_enabled
+                ),
+                preemption_batch_enabled=pc.get(
+                    "batch_scheduler_enabled", cfg.preemption_batch_enabled
+                ),
+                preemption_service_enabled=pc.get(
+                    "service_scheduler_enabled", cfg.preemption_service_enabled
+                ),
+            )
+            if new_cfg.scheduler_algorithm not in ("binpack", "spread"):
+                raise APIError(400, "scheduler_algorithm must be binpack|spread")
+            self.server._raft_apply(
+                lambda index: self.server.store.set_scheduler_config(
+                    index, new_cfg
+                )
+            )
+            return {"updated": True}
+        raise APIError(405, f"method {method} not allowed")
+
+    def handle_agent_self(self, method, body, query):
+        out = {
+            "member": {"name": "server-1", "status": "alive"},
+            "stats": {
+                "worker_count": len(self.server.workers),
+                "plan_queue_depth": self.server.plan_queue.depth(),
+                "blocked_evals": self.server.blocked_evals.blocked_count(),
+            },
+            "version": __import__("nomad_tpu").__version__,
+        }
+        if self.client is not None:
+            out["client"] = {
+                "node_id": self.client.node.id,
+                "allocs_running": self.client.num_allocs(),
+            }
+        return out
+
+    def handle_leader(self, method, body, query):
+        return f"{self.host}:{self.port}"
+
+    def handle_metrics(self, method, body, query):
+        from ..utils.metrics import global_metrics
+
+        return global_metrics.snapshot()

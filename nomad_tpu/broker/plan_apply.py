@@ -104,12 +104,44 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     return result
 
 
+def preemption_evals(store, result: PlanResult) -> list:
+    """One follow-up evaluation per job that lost allocations to
+    preemption, so victim jobs replace their capacity (the reference
+    applier creates PreemptionEvals in applyPlan, nomad/plan_apply.go)."""
+    from ..structs import Evaluation
+    from ..structs.evaluation import EVAL_STATUS_PENDING, TRIGGER_PREEMPTION
+
+    jobs: dict[tuple[str, str], object] = {}
+    for allocs in result.node_preemptions.values():
+        for a in allocs:
+            jobs.setdefault((a.namespace, a.job_id), a)
+    evals = []
+    for (ns, job_id), _a in jobs.items():
+        job = store.job_by_id(ns, job_id)
+        if job is None or job.stopped():
+            continue
+        evals.append(
+            Evaluation(
+                namespace=ns,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=TRIGGER_PREEMPTION,
+                job_id=job_id,
+                status=EVAL_STATUS_PENDING,
+            )
+        )
+    return evals
+
+
 class PlanApplier:
     """Serialized apply loop state: evaluate against live store, commit via
-    upsert_plan_results, bump indexes. One instance per leader."""
+    upsert_plan_results, bump indexes. One instance per leader.
+    ``on_evals_created`` (if set) receives preemption follow-up evals for
+    broker enqueue."""
 
-    def __init__(self, store):
+    def __init__(self, store, on_evals_created=None):
         self.store = store
+        self.on_evals_created = on_evals_created
         self._lock = threading.Lock()
 
     def apply(self, plan: Plan) -> PlanResult:
@@ -119,6 +151,14 @@ class PlanApplier:
                 index = self.store.latest_index + 1
                 self.store.upsert_plan_results(index, result, plan.eval_id)
                 result.alloc_index = index
+                if result.node_preemptions:
+                    evals = preemption_evals(self.store, result)
+                    if evals:
+                        self.store.upsert_evals(
+                            self.store.latest_index + 1, evals
+                        )
+                        if self.on_evals_created is not None:
+                            self.on_evals_created(evals)
             if result.rejected_nodes:
                 result.refresh_index = self.store.latest_index
             return result

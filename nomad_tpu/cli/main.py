@@ -1,0 +1,343 @@
+"""CLI — ``python -m nomad_tpu.cli``.
+
+Reference: command/ (~120 subcommands via mitchellh/cli). The operational
+core subset: agent -dev, job run/plan/status/stop, node status/drain/
+eligibility, alloc status, eval status, operator scheduler-config,
+server members. Talks to the HTTP API via the SDK (never in-process),
+matching the reference CLI's strict HTTP boundary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from ..api.client import APIException, NomadClient
+
+DEFAULT_ADDR = os.environ.get("NOMAD_TPU_ADDR", "http://127.0.0.1:4646")
+
+
+def _client(args) -> NomadClient:
+    return NomadClient(args.address)
+
+
+def _fail(msg: str) -> int:
+    print(f"error: {msg}", file=sys.stderr)
+    return 1
+
+
+def _load_jobfile(path: str) -> dict:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as e:
+        raise SystemExit(f"error: cannot read job file: {e}")
+    except json.JSONDecodeError as e:
+        raise SystemExit(f"error: {path} is not valid JSON: {e}")
+    return data.get("job", data)
+
+
+# -- commands ---------------------------------------------------------------
+def cmd_agent(args) -> int:
+    """Run a dev agent (server+client+HTTP) in the foreground."""
+    if not args.dev:
+        return _fail("only -dev mode is supported in this build")
+    from ..agent import DevAgent
+    from ..api.http import HTTPAgent
+
+    agent = DevAgent(data_dir=args.data_dir or None)
+    agent.start()
+    host, _, port = args.bind.partition(":")
+    http = HTTPAgent(
+        agent.server, agent.client, host=host or "127.0.0.1",
+        port=int(port or 4646),
+    )
+    http.start()
+    print(f"==> nomad-tpu dev agent running at {http.address}")
+    print(f"    node id: {agent.client.node.id}")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("==> shutting down")
+        http.stop()
+        agent.shutdown()
+    return 0
+
+
+def cmd_job_run(args) -> int:
+    job = _load_jobfile(args.file)
+    c = _client(args)
+    try:
+        out = c.jobs.register(job)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"==> evaluation {out['eval_id']} created")
+    if args.detach:
+        return 0
+    # poll until the eval completes (command/job_run.go monitor)
+    for _ in range(100):
+        ev = c.evaluations.info(out["eval_id"])
+        if ev["status"] in ("complete", "failed", "canceled"):
+            print(f"==> evaluation {out['eval_id']} finished: {ev['status']}")
+            if ev.get("failed_tg_allocs"):
+                for tg, m in ev["failed_tg_allocs"].items():
+                    print(f"    group {tg!r}: placement failed")
+                return 2
+            return 0
+        time.sleep(0.2)
+    return _fail("timed out waiting for evaluation")
+
+
+def cmd_job_plan(args) -> int:
+    job = _load_jobfile(args.file)
+    c = _client(args)
+    try:
+        out = c.jobs.plan(job)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"Job: {out['job_id']} ({out['diff_type']}, version {out['version']})")
+    for tg, ann in out.get("annotations", {}).items():
+        parts = [f"+{ann['place']} place"]
+        if ann.get("stop"):
+            parts.append(f"-{ann['stop']} stop")
+        if ann.get("preemptions"):
+            parts.append(f"!{ann['preemptions']} preempt")
+        print(f"  group {tg!r}: {', '.join(parts)}")
+    if out.get("failed_tg_allocs"):
+        print("  WARNING: some allocations would fail to place:")
+        for tg, m in out["failed_tg_allocs"].items():
+            print(f"    {tg}: {m}")
+    return 0
+
+
+def cmd_job_status(args) -> int:
+    c = _client(args)
+    if not args.job_id:
+        jobs = c.jobs.list()
+        if not jobs:
+            print("no jobs registered")
+            return 0
+        print(f"{'ID':<30} {'Type':<10} {'Priority':<9} {'Status':<10}")
+        for j in jobs:
+            print(f"{j['id']:<30} {j['type']:<10} {j['priority']:<9} {j['status']:<10}")
+        return 0
+    try:
+        job = c.jobs.info(args.job_id)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"ID       = {job['id']}")
+    print(f"Name     = {job['name']}")
+    print(f"Type     = {job['type']}")
+    print(f"Priority = {job['priority']}")
+    print(f"Status   = {job['status']}")
+    print(f"Version  = {job['version']}")
+    summary = c.jobs.summary(args.job_id)["summary"]
+    print("\nSummary")
+    hdr = f"{'Group':<15} {'Queued':<7} {'Starting':<9} {'Running':<8} {'Complete':<9} {'Failed':<7} {'Lost':<5}"
+    print(hdr)
+    for tg, s in summary.items():
+        print(
+            f"{tg:<15} {s.get('queued',0):<7} {s.get('starting',0):<9} "
+            f"{s.get('running',0):<8} {s.get('complete',0):<9} "
+            f"{s.get('failed',0):<7} {s.get('lost',0):<5}"
+        )
+    print("\nAllocations")
+    print(f"{'ID':<10} {'Node':<10} {'Group':<15} {'Desired':<8} {'Status':<10}")
+    for a in c.jobs.allocations(args.job_id):
+        print(
+            f"{a['id'][:8]:<10} {a['node_id'][:8]:<10} {a['task_group']:<15} "
+            f"{a['desired_status']:<8} {a['client_status']:<10}"
+        )
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    c = _client(args)
+    try:
+        out = c.jobs.deregister(args.job_id)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"==> deregistered, evaluation {out.get('eval_id', '')}")
+    return 0
+
+
+def cmd_node_status(args) -> int:
+    c = _client(args)
+    if args.node_id:
+        try:
+            n = c.nodes.info(args.node_id)
+        except APIException as e:
+            return _fail(str(e))
+        print(json.dumps(n, indent=2, default=str))
+        return 0
+    nodes = c.nodes.list()
+    print(f"{'ID':<10} {'Name':<20} {'DC':<8} {'Status':<8} {'Eligibility':<12}")
+    for n in nodes:
+        print(
+            f"{n['id'][:8]:<10} {n['name'][:18]:<20} {n['datacenter']:<8} "
+            f"{n['status']:<8} {n['scheduling_eligibility']:<12}"
+        )
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    c = _client(args)
+    try:
+        out = c.nodes.drain(args.node_id, enabled=not args.disable)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"==> drain {'disabled' if args.disable else 'enabled'}; evals: {len(out['eval_ids'])}")
+    return 0
+
+
+def cmd_node_eligibility(args) -> int:
+    c = _client(args)
+    try:
+        c.nodes.eligibility(args.node_id, eligible=args.enable)
+    except APIException as e:
+        return _fail(str(e))
+    print("==> eligibility updated")
+    return 0
+
+
+def cmd_alloc_status(args) -> int:
+    c = _client(args)
+    try:
+        a = c.allocations.info(args.alloc_id)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"ID            = {a['id']}")
+    print(f"Name          = {a['name']}")
+    print(f"Node ID       = {a['node_id']}")
+    print(f"Job ID        = {a['job_id']}")
+    print(f"Desired       = {a['desired_status']}")
+    print(f"Client Status = {a['client_status']}")
+    metrics = a.get("metrics") or {}
+    if metrics.get("scores"):
+        print("\nPlacement Metrics")
+        for k, v in metrics["scores"].items():
+            print(f"  {k} = {v:.4f}")
+        print(f"  nodes evaluated = {metrics.get('nodes_evaluated')}")
+    return 0
+
+
+def cmd_eval_status(args) -> int:
+    c = _client(args)
+    try:
+        e = c.evaluations.info(args.eval_id)
+    except APIException as e2:
+        return _fail(str(e2))
+    print(json.dumps(e, indent=2, default=str))
+    return 0
+
+
+def cmd_operator_scheduler(args) -> int:
+    c = _client(args)
+    if args.algorithm:
+        c.operator.set_scheduler_config(scheduler_algorithm=args.algorithm)
+        print(f"==> scheduler algorithm set to {args.algorithm}")
+    cfg = c.operator.scheduler_config()
+    print(json.dumps(cfg, indent=2))
+    return 0
+
+
+def cmd_server_members(args) -> int:
+    c = _client(args)
+    info = c.agent.self()
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+# -- parser -----------------------------------------------------------------
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nomad-tpu")
+    p.add_argument("--address", default=DEFAULT_ADDR)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    agent = sub.add_parser("agent", help="run an agent")
+    agent.add_argument("-dev", action="store_true", dest="dev")
+    agent.add_argument("--data-dir", default="")
+    agent.add_argument("--bind", default="127.0.0.1:4646")
+    agent.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job", help="job commands").add_subparsers(
+        dest="sub", required=True
+    )
+    run = job.add_parser("run")
+    run.add_argument("file")
+    run.add_argument("-detach", action="store_true")
+    run.set_defaults(fn=cmd_job_run)
+    plan = job.add_parser("plan")
+    plan.add_argument("file")
+    plan.set_defaults(fn=cmd_job_plan)
+    status = job.add_parser("status")
+    status.add_argument("job_id", nargs="?")
+    status.set_defaults(fn=cmd_job_status)
+    stop = job.add_parser("stop")
+    stop.add_argument("job_id")
+    stop.set_defaults(fn=cmd_job_stop)
+
+    node = sub.add_parser("node", help="node commands").add_subparsers(
+        dest="sub", required=True
+    )
+    nstatus = node.add_parser("status")
+    nstatus.add_argument("node_id", nargs="?")
+    nstatus.set_defaults(fn=cmd_node_status)
+    drain = node.add_parser("drain")
+    drain.add_argument("node_id")
+    drain.add_argument("-disable", action="store_true")
+    drain.set_defaults(fn=cmd_node_drain)
+    elig = node.add_parser("eligibility")
+    elig.add_argument("node_id")
+    elig.add_argument("-enable", action="store_true")
+    elig.set_defaults(fn=cmd_node_eligibility)
+
+    alloc = sub.add_parser("alloc", help="alloc commands").add_subparsers(
+        dest="sub", required=True
+    )
+    astatus = alloc.add_parser("status")
+    astatus.add_argument("alloc_id")
+    astatus.set_defaults(fn=cmd_alloc_status)
+
+    ev = sub.add_parser("eval", help="eval commands").add_subparsers(
+        dest="sub", required=True
+    )
+    estatus = ev.add_parser("status")
+    estatus.add_argument("eval_id")
+    estatus.set_defaults(fn=cmd_eval_status)
+
+    op = sub.add_parser("operator", help="operator commands").add_subparsers(
+        dest="sub", required=True
+    )
+    sched = op.add_parser("scheduler")
+    sched.add_argument("--algorithm", choices=["binpack", "spread"])
+    sched.set_defaults(fn=cmd_operator_scheduler)
+
+    server = sub.add_parser("server", help="server commands").add_subparsers(
+        dest="sub", required=True
+    )
+    members = server.add_parser("members")
+    members.set_defaults(fn=cmd_server_members)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # output piped to a closed reader (e.g. `| head`) — not an error
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

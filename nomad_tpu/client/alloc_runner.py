@@ -1,0 +1,124 @@
+"""AllocRunner — per-allocation supervisor.
+
+Reference: client/allocrunner/alloc_runner.go (:36-120): set up the alloc
+dir, run one TaskRunner per task (leader/sidecar ordering via the task
+hook coordinator is honored in its simplest form: all mains in parallel),
+aggregate task states into the alloc's client status, and report changes
+up to the client for batched server sync.
+
+Client status derivation mirrors getClientStatus (alloc_runner.go):
+any task failed ⇒ failed; any running ⇒ running; all dead+ok ⇒ complete.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Callable, Optional
+
+from ..structs import (
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_PENDING,
+    ALLOC_CLIENT_RUNNING,
+    Allocation,
+)
+from .task_runner import TaskRunner, TaskState
+
+
+class AllocRunner:
+    def __init__(
+        self,
+        alloc: Allocation,
+        drivers: dict,
+        data_dir: str,
+        on_update: Optional[Callable[[Allocation, str, dict], None]] = None,
+    ):
+        self.alloc = alloc
+        self.drivers = drivers
+        self.alloc_dir = os.path.join(data_dir, "allocs", alloc.id)
+        self.on_update = on_update
+        self.task_runners: dict[str, TaskRunner] = {}
+        self.task_states: dict[str, TaskState] = {}
+        self._lock = threading.Lock()
+        self._destroyed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> None:
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        if tg is None:
+            self._report(ALLOC_CLIENT_FAILED, "unknown task group")
+            return
+        os.makedirs(self.alloc_dir, exist_ok=True)
+        env = {
+            "NOMAD_ALLOC_ID": self.alloc.id,
+            "NOMAD_ALLOC_NAME": self.alloc.name,
+            "NOMAD_ALLOC_INDEX": str(self.alloc.index()),
+            "NOMAD_ALLOC_DIR": os.path.join(self.alloc_dir, "shared"),
+            "NOMAD_JOB_NAME": job.name if job else "",
+            "NOMAD_GROUP_NAME": tg.name,
+        }
+        os.makedirs(env["NOMAD_ALLOC_DIR"], exist_ok=True)
+        for task in tg.tasks:
+            driver = self.drivers.get(task.driver)
+            if driver is None:
+                self._report(
+                    ALLOC_CLIENT_FAILED, f"driver {task.driver!r} not found"
+                )
+                return
+            tr = TaskRunner(
+                task=task,
+                driver=driver,
+                task_dir=os.path.join(self.alloc_dir, task.name),
+                env=env,
+                restart_policy=tg.restart_policy,
+                on_state_change=self._on_task_state,
+            )
+            self.task_runners[task.name] = tr
+        for tr in self.task_runners.values():
+            tr.start()
+        self._report(ALLOC_CLIENT_RUNNING, "tasks are running")
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        for tr in self.task_runners.values():
+            tr.join(timeout=timeout)
+
+    def stop(self) -> None:
+        """Graceful stop (desired_status=stop): leader-last kill order."""
+        for tr in self.task_runners.values():
+            tr.kill()
+        self._report(self.client_status(), "alloc stopped")
+
+    def destroy(self) -> None:
+        """GC: stop + remove the alloc dir (client/gc.go)."""
+        self.stop()
+        self._destroyed = True
+        shutil.rmtree(self.alloc_dir, ignore_errors=True)
+
+    # -- status ------------------------------------------------------------
+    def _on_task_state(self, name: str, state: TaskState) -> None:
+        with self._lock:
+            self.task_states[name] = state
+        self._report(self.client_status(), "")
+
+    def client_status(self) -> str:
+        states = list(self.task_states.values())
+        if not states:
+            return ALLOC_CLIENT_PENDING
+        if any(s.failed for s in states):
+            return ALLOC_CLIENT_FAILED
+        if any(s.state == "running" for s in states):
+            return ALLOC_CLIENT_RUNNING
+        if all(s.state == "dead" for s in states):
+            return ALLOC_CLIENT_COMPLETE
+        return ALLOC_CLIENT_PENDING
+
+    def is_terminal(self) -> bool:
+        states = list(self.task_states.values())
+        return bool(states) and all(s.state == "dead" for s in states)
+
+    def _report(self, status: str, desc: str) -> None:
+        if self.on_update is not None:
+            self.on_update(self.alloc, status, dict(self.task_states))

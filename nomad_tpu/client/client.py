@@ -1,0 +1,172 @@
+"""Client — the node agent kernel.
+
+Reference: client/client.go (:167 Client): fingerprint + register the
+node, heartbeat on the server-assigned TTL, watch allocations (blocking
+pull keyed by state index, client.go watchAllocations), reconcile local
+AllocRunners against desired state (run new, stop stopped, GC removed),
+and sync alloc status back in batches (200 ms batching, client.go:99-101).
+
+The server link is the ``ServerRPC`` seam — in-process for the dev agent,
+msgpack/gRPC transport later without touching this file.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional, Protocol
+
+from ..structs import (
+    ALLOC_DESIRED_RUN,
+    ALLOC_DESIRED_STOP,
+    Allocation,
+    Node,
+)
+from .alloc_runner import AllocRunner
+from .drivers import builtin_drivers
+from .fingerprint import fingerprint_node
+
+log = logging.getLogger("nomad_tpu.client")
+
+ALLOC_SYNC_INTERVAL = 0.2  # client.go:99-101 allocSyncIntv
+
+
+class ServerRPC(Protocol):
+    def register_node(self, node: Node) -> None: ...
+
+    def heartbeat(self, node_id: str) -> float: ...  # returns TTL seconds
+
+    def pull_allocs(
+        self, node_id: str, min_index: int, timeout: float
+    ) -> tuple[list[Allocation], int]: ...
+
+    def update_allocs(self, updates: list[Allocation]) -> None: ...
+
+
+class Client:
+    def __init__(
+        self,
+        rpc: ServerRPC,
+        data_dir: str,
+        node: Optional[Node] = None,
+        heartbeat_interval: Optional[float] = None,
+    ):
+        self.rpc = rpc
+        self.data_dir = data_dir
+        self.drivers = builtin_drivers()
+        self.node = fingerprint_node(node, data_dir=data_dir, drivers=self.drivers)
+        self.heartbeat_interval = heartbeat_interval
+        self.runners: dict[str, AllocRunner] = {}
+        self._pending_updates: dict[str, Allocation] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._last_index = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.node.status = "ready"
+        self.rpc.register_node(self.node)
+        for fn, name in (
+            (self._heartbeat_loop, "heartbeat"),
+            (self._watch_allocations, "alloc-watch"),
+            (self._sync_loop, "alloc-sync"),
+        ):
+            t = threading.Thread(target=fn, name=f"client-{name}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for r in list(self.runners.values()):
+            r.stop()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    # -- heartbeats --------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ttl = self.rpc.heartbeat(self.node.id)
+            except Exception:
+                log.exception("heartbeat failed")
+                ttl = 1.0
+            interval = self.heartbeat_interval or max(ttl / 2.0, 0.05)
+            self._stop.wait(interval)
+
+    # -- alloc pull + reconcile (client.go watchAllocations) ---------------
+    def _watch_allocations(self) -> None:
+        while not self._stop.is_set():
+            try:
+                allocs, index = self.rpc.pull_allocs(
+                    self.node.id, self._last_index, timeout=1.0
+                )
+            except Exception:
+                log.exception("alloc pull failed")
+                self._stop.wait(1.0)
+                continue
+            if index <= self._last_index:
+                continue
+            self._last_index = index
+            self._reconcile(allocs)
+
+    def _reconcile(self, allocs: list[Allocation]) -> None:
+        desired = {a.id: a for a in allocs}
+        with self._lock:
+            running = dict(self.runners)
+        # stop / destroy
+        for alloc_id, runner in running.items():
+            a = desired.get(alloc_id)
+            if a is None:
+                runner.destroy()
+                with self._lock:
+                    self.runners.pop(alloc_id, None)
+            elif a.desired_status in (ALLOC_DESIRED_STOP, "evict"):
+                if not runner._destroyed:
+                    runner.stop()
+        # start new
+        for alloc_id, a in desired.items():
+            if a.desired_status != ALLOC_DESIRED_RUN:
+                continue
+            if a.terminal_status() or alloc_id in running:
+                continue
+            runner = AllocRunner(
+                a, self.drivers, self.data_dir, on_update=self._on_alloc_update
+            )
+            with self._lock:
+                self.runners[alloc_id] = runner
+            threading.Thread(
+                target=runner.run, name=f"alloc-{alloc_id[:8]}", daemon=True
+            ).start()
+
+    # -- status sync -------------------------------------------------------
+    def _on_alloc_update(self, alloc: Allocation, status: str, task_states) -> None:
+        upd = alloc.copy_for_update()
+        upd.client_status = status
+        upd.task_states = {
+            name: {"state": s.state, "failed": s.failed, "restarts": s.restarts}
+            for name, s in task_states.items()
+        }
+        with self._lock:
+            self._pending_updates[alloc.id] = upd
+
+    def _sync_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(ALLOC_SYNC_INTERVAL)
+            with self._lock:
+                batch = list(self._pending_updates.values())
+                self._pending_updates.clear()
+            if batch:
+                try:
+                    self.rpc.update_allocs(batch)
+                except Exception:
+                    log.exception("alloc status sync failed")
+                    with self._lock:
+                        for u in batch:
+                            self._pending_updates.setdefault(u.id, u)
+
+    # -- introspection -----------------------------------------------------
+    def num_allocs(self) -> int:
+        with self._lock:
+            return len(self.runners)

@@ -1,0 +1,192 @@
+"""Task drivers — the execution plugins.
+
+Reference: the TaskDriver gRPC contract (plugins/drivers/driver.go,
+plugins/drivers/proto/driver.proto: Start/Wait/Stop/Inspect/Recover) and
+the built-in drivers (drivers/{mock,rawexec,exec}). The contract here is
+the same shape, in-process for the built-ins; out-of-process gRPC plugins
+slot in behind the same ``TaskDriver`` interface (the executor subprocess
+the reference re-execs, drivers/shared/executor, maps to the C++ executor
+planned for the native runtime layer).
+
+- ``mock_driver``: deterministic fake (run_for / exit_code / start_error)
+  — the workhorse of client tests, mirroring drivers/mock.
+- ``raw_exec`` / ``exec``: fork/exec of task.config["command"]+["args"]
+  with env + alloc dir plumbing. (``exec`` currently shares raw_exec's
+  no-isolation path; chroot/cgroup isolation is the C++ executor's job.)
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+TASK_STATE_PENDING = "pending"
+TASK_STATE_RUNNING = "running"
+TASK_STATE_DEAD = "dead"
+
+
+@dataclass
+class TaskHandle:
+    """Reattachable task handle (plugins/drivers/task_handle.go)."""
+
+    id: str
+    driver: str
+    pid: int = 0
+    state: str = TASK_STATE_RUNNING
+    exit_code: Optional[int] = None
+    started_at: float = field(default_factory=time.time)
+    completed_at: float = 0.0
+    meta: dict = field(default_factory=dict)
+
+
+class DriverError(Exception):
+    pass
+
+
+class TaskDriver:
+    name = "base"
+
+    def fingerprint(self) -> bool:
+        return True
+
+    def start(self, task, env: dict, task_dir: str) -> TaskHandle:
+        raise NotImplementedError
+
+    def wait(self, handle: TaskHandle, timeout: Optional[float] = None) -> Optional[int]:
+        """Block until exit; returns exit code (None on timeout)."""
+        raise NotImplementedError
+
+    def stop(self, handle: TaskHandle, kill_timeout: float = 5.0) -> None:
+        raise NotImplementedError
+
+    def inspect(self, handle: TaskHandle) -> TaskHandle:
+        return handle
+
+
+class MockDriver(TaskDriver):
+    """drivers/mock: configurable timing/failure knobs via task.config:
+    run_for (s), exit_code, start_error, start_block_for (s)."""
+
+    name = "mock_driver"
+
+    def __init__(self):
+        self._events: dict[str, threading.Event] = {}
+        self._handles: dict[str, TaskHandle] = {}
+
+    def start(self, task, env, task_dir) -> TaskHandle:
+        cfg = task.config or {}
+        if cfg.get("start_error"):
+            raise DriverError(str(cfg["start_error"]))
+        if cfg.get("start_block_for"):
+            time.sleep(float(cfg["start_block_for"]))
+        h = TaskHandle(id=str(uuid.uuid4()), driver=self.name)
+        h.meta["run_for"] = float(cfg.get("run_for", 0.0))
+        h.meta["exit_code"] = int(cfg.get("exit_code", 0))
+        h.meta["deadline"] = h.started_at + h.meta["run_for"]
+        self._events[h.id] = threading.Event()
+        self._handles[h.id] = h
+        return h
+
+    def wait(self, handle, timeout=None):
+        remaining = handle.meta["deadline"] - time.time()
+        stop_evt = self._events.get(handle.id)
+        waited = stop_evt.wait(max(remaining, 0)) if stop_evt else False
+        if timeout is not None and remaining > timeout:
+            return None
+        handle.state = TASK_STATE_DEAD
+        handle.completed_at = time.time()
+        handle.exit_code = 130 if waited else handle.meta["exit_code"]
+        return handle.exit_code
+
+    def stop(self, handle, kill_timeout=5.0):
+        evt = self._events.get(handle.id)
+        if evt:
+            evt.set()
+
+
+class RawExecDriver(TaskDriver):
+    """drivers/rawexec: no isolation, direct fork/exec."""
+
+    name = "raw_exec"
+
+    def __init__(self):
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    def fingerprint(self) -> bool:
+        return os.name == "posix"
+
+    def start(self, task, env, task_dir) -> TaskHandle:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise DriverError("raw_exec requires config['command']")
+        argv = [command] + list(cfg.get("args", []))
+        stdout = open(os.path.join(task_dir, f"{task.name}.stdout"), "ab")
+        stderr = open(os.path.join(task_dir, f"{task.name}.stderr"), "ab")
+        try:
+            proc = subprocess.Popen(
+                argv,
+                cwd=task_dir,
+                env={**os.environ, **env},
+                stdout=stdout,
+                stderr=stderr,
+                start_new_session=True,  # own process group for clean kill
+            )
+        except OSError as e:
+            raise DriverError(f"failed to exec {command}: {e}") from e
+        finally:
+            stdout.close()
+            stderr.close()
+        h = TaskHandle(id=str(uuid.uuid4()), driver=self.name, pid=proc.pid)
+        self._procs[h.id] = proc
+        return h
+
+    def wait(self, handle, timeout=None):
+        proc = self._procs.get(handle.id)
+        if proc is None:
+            return handle.exit_code
+        try:
+            code = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return None
+        handle.state = TASK_STATE_DEAD
+        handle.exit_code = code
+        handle.completed_at = time.time()
+        return code
+
+    def stop(self, handle, kill_timeout=5.0):
+        proc = self._procs.get(handle.id)
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        try:
+            proc.wait(timeout=kill_timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+
+class ExecDriver(RawExecDriver):
+    """drivers/exec — isolation (chroot/cgroups via the native executor)
+    pending; currently runs the raw_exec path with the exec contract."""
+
+    name = "exec"
+
+
+def builtin_drivers() -> dict[str, TaskDriver]:
+    """The in-process driver catalog (helper/pluginutils/catalog analog)."""
+    return {
+        d.name: d for d in (MockDriver(), RawExecDriver(), ExecDriver())
+    }

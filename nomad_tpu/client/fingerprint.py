@@ -1,0 +1,89 @@
+"""Node fingerprinting: detect attributes, resources, and drivers.
+
+Reference: client/fingerprint/ (~40 detectors: arch, cpu, memory, storage,
+kernel, nomad version, drivers) orchestrated by client/fingerprint_manager.go.
+Here one pass over procfs/os APIs fills the same attribute namespace
+(``cpu.*``, ``memory.*``, ``kernel.*``, ``unique.*``, ``driver.*``).
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import os
+import platform
+import shutil
+import socket
+import uuid
+
+from ..structs import Node, NodeResources
+
+from .. import __version__
+
+
+def _total_memory_mb() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) // 1024
+    except OSError:
+        pass
+    return 4096
+
+
+def _disk_mb(path: str = "/") -> int:
+    try:
+        st = os.statvfs(path)
+        return int(st.f_frsize * st.f_blocks / (1024 * 1024))
+    except OSError:
+        return 50 * 1024
+
+
+def _cpu_mhz() -> int:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("cpu mhz"):
+                    return int(float(line.split(":")[1]))
+    except OSError:
+        pass
+    return 2000
+
+
+def fingerprint_node(
+    node: Node | None = None, *, data_dir: str = "", drivers=None
+) -> Node:
+    """Build (or refresh) a Node from the host. ``drivers`` is the driver
+    registry used for driver.* attributes (client/fingerprint_manager.go
+    fingerprints plugins through the same pass)."""
+    node = node or Node(id=str(uuid.uuid4()))
+    cores = multiprocessing.cpu_count()
+    mhz = _cpu_mhz()
+    node.name = node.name or socket.gethostname()
+    node.attributes.update(
+        {
+            "kernel.name": platform.system().lower(),
+            "kernel.version": platform.release(),
+            "arch": platform.machine(),
+            "os.name": platform.system().lower(),
+            "cpu.numcores": str(cores),
+            "cpu.frequency": str(mhz),
+            "cpu.totalcompute": str(cores * mhz),
+            "memory.totalbytes": str(_total_memory_mb() * 1024 * 1024),
+            "nomad.version": __version__,
+            "unique.hostname": socket.gethostname(),
+            "unique.storage.volume": data_dir or "/tmp",
+        }
+    )
+    node.node_resources = NodeResources(
+        cpu=cores * mhz,
+        memory_mb=_total_memory_mb(),
+        disk_mb=_disk_mb(data_dir or "/"),
+    )
+    if drivers is not None:
+        for name, drv in drivers.items():
+            healthy = drv.fingerprint()
+            node.drivers[name] = healthy
+            node.attributes[f"driver.{name}"] = "1" if healthy else "0"
+    node.compute_class()
+    return node

@@ -1,0 +1,213 @@
+"""Vectorized preemption — the knapsack relaxation of the reference's
+greedy victim search.
+
+Reference semantics (scheduler/preemption.go):
+- Eligibility: victim priority ≤ job priority − 10
+  (filterAndGroupPreemptibleAllocs :663-697).
+- Victim choice per node: group by priority ascending, then nearest
+  resource distance first (PreemptForTaskGroup :198-265,
+  basicResourceDistance :608-624) — take victims until the ask fits.
+- Redundancy: drop victims whose removal isn't needed (filterSuperset
+  :702-733).
+- Scoring: preempting options are down-ranked by a logistic of the summed
+  victim priorities, inflection at net priority 2048
+  (rank.go:775-844 PreemptionScoringIterator / preemptionScore).
+
+TPU reformulation (SURVEY.md §7 step 6): all nodes evaluated at once.
+Victims are padded to ``[N, V]``; one vectorized pass does
+
+    order   = argsort by (priority, resource-distance)      # segmented sort
+    prefix  = cumsum of victim resources in that order      # prefix scan
+    k[n]    = first prefix index where used − prefix + ask ≤ capacity
+    net[n]  = sum of the first k victims' priorities
+    score   = base_score(n) · logistic(net)                 # preemption penalty
+
+The reference's superset filter falls out for free: taking the *minimal
+feasible prefix* of the sorted order never includes a redundant victim in
+the single-resource-direction sense the greedy covers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Priority delta a preemptor must have over its victims
+# (preemption.go:673: delta ≥ 10).
+PREEMPTION_PRIORITY_DELTA = 10
+# Logistic inflection point for the net-priority penalty (rank.go:842).
+NET_PRIORITY_INFLECTION = 2048.0
+
+
+def preemption_score(net_priority):
+    """Down-weight for preempting options: ≈1 for cheap preemptions, →0 as
+    summed victim priority passes the inflection (rank.go:834-844)."""
+    return 1.0 / (1.0 + jnp.exp((net_priority - NET_PRIORITY_INFLECTION) / 256.0))
+
+
+def resource_distance(ask, victim):
+    """basicResourceDistance (preemption.go:608-624): L2 over the relative
+    per-dimension deltas — closer victims waste less."""
+    rel = (victim - ask) / jnp.maximum(ask, 1.0)
+    return jnp.sqrt(jnp.sum(rel * rel, axis=-1))
+
+
+@jax.jit
+def find_preemption_kernel(
+    capacity,  # f32[N, D]
+    used,  # f32[N, D] (incl. victims)
+    ask,  # f32[D]
+    eligible,  # bool[N] (constraint/dc mask, ignoring resource fit)
+    victim_res,  # f32[N, V, D] resources per candidate victim
+    victim_prio,  # i32[N, V] victim priorities (already delta-filtered)
+    victim_mask,  # bool[N, V] real victims vs padding
+):
+    """For every node, the minimal sorted victim prefix that frees room.
+
+    Returns (feasible bool[N], k i32[N] victims needed, net_priority f32[N],
+    order i32[N, V] victim index order). Host maps (node, order[:k]) back to
+    allocation ids with the same deterministic key.
+    """
+    n, v, d = victim_res.shape
+    big = jnp.float32(1e9)
+
+    dist = resource_distance(ask[None, None, :], victim_res)  # [N, V]
+    # sort key: priority major, distance minor; padding last
+    key = victim_prio.astype(jnp.float32) * 1e4 + jnp.minimum(dist, 9e3)
+    key = jnp.where(victim_mask, key, big)
+    order = jnp.argsort(key, axis=1)  # [N, V]
+
+    sorted_res = jnp.take_along_axis(victim_res, order[:, :, None], axis=1)
+    sorted_prio = jnp.take_along_axis(
+        jnp.where(victim_mask, victim_prio, 0), order, axis=1
+    )
+    sorted_mask = jnp.take_along_axis(victim_mask, order, axis=1)
+
+    freed = jnp.cumsum(
+        jnp.where(sorted_mask[:, :, None], sorted_res, 0.0), axis=1
+    )  # [N, V, D]
+    # after freeing the first (i+1) victims, does the ask fit?
+    fits_after = jnp.all(
+        used[:, None, :] - freed + ask[None, None, :] <= capacity[:, None, :],
+        axis=-1,
+    ) & sorted_mask  # [N, V]
+
+    any_fit = jnp.any(fits_after, axis=1) & eligible
+    k = jnp.argmax(fits_after, axis=1) + 1  # victims needed (first hit)
+    k = jnp.where(any_fit, k, 0)
+
+    prio_prefix = jnp.cumsum(sorted_prio * sorted_mask, axis=1)  # [N, V]
+    net = jnp.where(
+        any_fit,
+        jnp.take_along_axis(
+            prio_prefix, jnp.maximum(k - 1, 0)[:, None], axis=1
+        )[:, 0].astype(jnp.float32),
+        0.0,
+    )
+    return any_fit, k.astype(jnp.int32), net, order.astype(jnp.int32)
+
+
+@jax.jit
+def choose_preemption_node_kernel(
+    capacity,
+    used,
+    ask,
+    eligible,
+    victim_res,
+    victim_prio,
+    victim_mask,
+):
+    """Pick the best node to preempt on: binpack fit score (post-placement)
+    scaled by the preemption penalty. Returns (best i32, feasible bool[N],
+    k, net, order)."""
+    from .score import _pow10
+
+    feasible, k, net, order = find_preemption_kernel(
+        capacity, used, ask, eligible, victim_res, victim_prio, victim_mask
+    )
+    # fit score after preempting + placing (approximate: fully-freed victims)
+    freed = jnp.sum(
+        jnp.where(victim_mask[:, :, None], victim_res, 0.0), axis=1
+    )
+    proposed = used - freed + ask
+    free_frac = jnp.where(
+        capacity > 0, (capacity - proposed) / jnp.maximum(capacity, 1e-9), 1.0
+    )
+    fit = jnp.clip(
+        20.0 - _pow10(free_frac[:, 0]) - _pow10(free_frac[:, 1]), 0.0, 18.0
+    ) / 18.0
+    score = fit * preemption_score(net)
+    score = jnp.where(feasible, score, -jnp.inf)
+    best = jnp.argmax(score)
+    return best, feasible, k, net, order
+
+
+def _victim_bucket(n: int) -> int:
+    """Pad the victim axis to a power of two so victim-count churn doesn't
+    retrigger XLA compilation (same policy as score._steps_bucket)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def build_victim_tensors(ct, snap, job, exclude_ids=frozenset()):
+    """Flatten preemption candidates: for every node row, the allocs whose
+    priority is ≤ job.priority − 10 (preemption.go:663-697), padded to a
+    power-of-two victim bucket. ``exclude_ids`` drops allocs already
+    preempted by the in-flight plan (their capacity is freed once, not
+    twice). Returns (victim_res, victim_prio, victim_mask,
+    victim_ids[list per node])."""
+    pn = ct.padded_n
+    max_prio = job.priority - PREEMPTION_PRIORITY_DELTA
+    per_node: list[list] = [[] for _ in range(pn)]
+    for row, node_id in enumerate(ct.node_ids):
+        for a in snap.allocs_by_node(node_id):
+            if a.terminal_status() or a.id in exclude_ids:
+                continue
+            prio = a.job.priority if a.job is not None else 50
+            if prio <= max_prio:
+                per_node[row].append((a, prio))
+    v = _victim_bucket(max((len(x) for x in per_node), default=1) or 1)
+    victim_res = np.zeros((pn, v, 4), dtype=np.float32)
+    victim_prio = np.zeros((pn, v), dtype=np.int32)
+    victim_mask = np.zeros((pn, v), dtype=bool)
+    victim_ids: list[list[str]] = [[] for _ in range(pn)]
+    for row, cands in enumerate(per_node):
+        for j, (a, prio) in enumerate(cands):
+            victim_res[row, j] = a.comparable_resources().to_vector()
+            victim_prio[row, j] = prio
+            victim_mask[row, j] = True
+            victim_ids[row].append(a.id)
+    return victim_res, victim_prio, victim_mask, victim_ids
+
+
+def find_preemptions(ct, snap, job, ask_vec, eligible, exclude_ids=frozenset()):
+    """Host driver: one device pass, then map the chosen node's sorted
+    victim prefix back to allocation ids. Returns (node_row, [alloc ids])
+    or (None, [])."""
+    victim_res, victim_prio, victim_mask, victim_ids = build_victim_tensors(
+        ct, snap, job, exclude_ids=exclude_ids
+    )
+    if not victim_mask.any():
+        return None, []
+    best, feasible, k, net, order = choose_preemption_node_kernel(
+        jnp.asarray(ct.capacity),
+        jnp.asarray(ct.used),
+        jnp.asarray(ask_vec),
+        jnp.asarray(eligible),
+        jnp.asarray(victim_res),
+        jnp.asarray(victim_prio),
+        jnp.asarray(victim_mask),
+    )
+    best = int(best)
+    if not bool(np.asarray(feasible)[best]):
+        return None, []
+    kk = int(np.asarray(k)[best])
+    node_order = np.asarray(order)[best]
+    ids = []
+    for idx in node_order[:kk]:
+        if idx < len(victim_ids[best]):
+            ids.append(victim_ids[best][idx])
+    return best, ids

@@ -97,6 +97,7 @@ class GenericScheduler:
             else MAX_SERVICE_SCHEDULE_ATTEMPTS
         )
         cfg = self.snapshot.scheduler_config()
+        self.scheduler_config = cfg
         self.kernel = PlacementKernel(cfg.scheduler_algorithm)
 
         success = False
@@ -176,12 +177,28 @@ class GenericScheduler:
             linked.followup_eval_id = f.id
             self.plan.append_alloc(linked)
 
+        # baseline queued = everything this eval will try to place (fresh
+        # placements AND destructive replacements, both in ``placements``)
         self.queued_allocs = {
-            tg: c["place"] for tg, c in results.desired_tg_updates.items()
+            tg: c["place"] + c["destructive_update"]
+            for tg, c in results.desired_tg_updates.items()
         }
 
         if placements and self.job is not None:
             self._compute_placements(placements, tainted)
+            # queued = what we could NOT place (adjustQueuedAllocations,
+            # scheduler/util.go:954 — planned allocs are subtracted)
+            placed_per_tg: dict[str, int] = {}
+            for allocs in self.plan.node_allocation.values():
+                for a in allocs:
+                    if a.eval_id == self.eval.id and a.client_status == "pending":
+                        placed_per_tg[a.task_group] = (
+                            placed_per_tg.get(a.task_group, 0) + 1
+                        )
+            for tg in list(self.queued_allocs):
+                self.queued_allocs[tg] = max(
+                    0, self.queued_allocs[tg] - placed_per_tg.get(tg, 0)
+                )
 
         if self.plan.is_no_op() and not self.followup_evals:
             self._finished = True
@@ -242,7 +259,7 @@ class GenericScheduler:
                 penalty_node_ids=penalty_nodes,
             )
             asks.append(ga)
-            tg_order.append((tg_name, prs, tg))
+            tg_order.append((tg_name, prs, tg, ga))
 
         results = self.kernel.place(ct, asks)
 
@@ -252,7 +269,7 @@ class GenericScheduler:
                 nodes_available[n.datacenter] = (
                     nodes_available.get(n.datacenter, 0) + 1
                 )
-        for (tg_name, prs, tg), res in zip(tg_order, results):
+        for (tg_name, prs, tg, ga), res in zip(tg_order, results):
             ask_res = tg.combined_resources()
             comparable = ComparableResources(
                 cpu=ask_res.cpu,
@@ -267,6 +284,11 @@ class GenericScheduler:
                     nodes_available=dict(nodes_available),
                 )
                 if row < 0:
+                    # second pass with preemption enabled
+                    # (generic_sched.go:773-792 selectNextOption)
+                    placed = self._try_preempt(ct, pr, tg_name, ga, comparable)
+                    if placed:
+                        continue
                     n_failed += 1
                     metric.coalesced_failures = 0
                     self._record_failure(tg_name, metric)
@@ -310,6 +332,87 @@ class GenericScheduler:
                         )
                         alloc.reschedule_tracker = RescheduleTracker(events=events)
                 self.plan.append_alloc(alloc)
+
+    def _preemption_enabled(self) -> bool:
+        cfg = self.scheduler_config
+        return (
+            cfg.preemption_batch_enabled
+            if self.batch
+            else cfg.preemption_service_enabled
+        )
+
+    def _try_preempt(self, ct, pr, tg_name, ga, comparable) -> bool:
+        """Preemption fallback for one failed placement: one device pass
+        finds the cheapest feasible victim set across all nodes
+        (device/preempt.py); victims are evicted in-plan and the placement
+        lands on their node (generic_sched.go:795 handlePreemptions)."""
+        if not self._preemption_enabled() or self.job is None:
+            return False
+        from ..device.preempt import PREEMPTION_PRIORITY_DELTA, find_preemptions
+
+        if self.job.priority < PREEMPTION_PRIORITY_DELTA:
+            return False
+        # hard constraints still bind under preemption: distinct_hosts
+        # excludes nodes already holding this job (snapshot + in-plan)
+        eligible = ga.eligible
+        if ga.distinct_hosts:
+            eligible = eligible & (ga.job_counts == 0)
+            for node_id, allocs in self.plan.node_allocation.items():
+                if any(a.job_id == self.job.id for a in allocs):
+                    r = ct.node_row.get(node_id)
+                    if r is not None:
+                        eligible = eligible.copy()
+                        eligible[r] = False
+        # allocs already evicted by this plan free capacity exactly once
+        already_preempted = {
+            a.id
+            for allocs in self.plan.node_preemptions.values()
+            for a in allocs
+        }
+        row, victim_ids = find_preemptions(
+            ct,
+            self.snapshot,
+            self.job,
+            ga.ask,
+            eligible,
+            exclude_ids=already_preempted,
+        )
+        if row is None or not victim_ids:
+            return False
+        node_id = ct.node_ids[row]
+        alloc_id = new_id()
+        victim_total = None
+        for vid in victim_ids:
+            victim = self.snapshot.alloc_by_id(vid)
+            if victim is None:
+                return False
+            self.plan.append_preempted_alloc(victim, alloc_id)
+            vec = victim.comparable_resources().to_vector()
+            victim_total = vec if victim_total is None else victim_total + vec
+        metric = AllocMetric(nodes_evaluated=ct.num_nodes)
+        metric.scores[f"{node_id}.preemption"] = 1.0
+        alloc = Allocation(
+            id=alloc_id,
+            namespace=self.job.namespace,
+            eval_id=self.eval.id,
+            name=pr.name,
+            node_id=node_id,
+            job_id=self.job.id,
+            job=self.job,
+            job_version=self.job.version,
+            task_group=tg_name,
+            resources=comparable.copy(),
+            desired_status=ALLOC_DESIRED_RUN,
+            client_status="pending",
+            metrics=metric,
+            preempted_allocations=list(victim_ids),
+        )
+        if pr.previous_alloc is not None:
+            alloc.previous_allocation = pr.previous_alloc.id
+        self.plan.append_alloc(alloc)
+        # keep the device-resident usage honest for subsequent fallbacks
+        ct.used[row] += ga.ask - (victim_total if victim_total is not None else 0)
+        return True
 
     def _record_failure(self, tg_name: str, metric: AllocMetric) -> None:
         existing = self.failed_tg_allocs.get(tg_name)

@@ -277,8 +277,9 @@ def reconcile(
                 r.inplace_update.append(UpdateRequest(a, job))
                 counts["in_place_update"] += 1
 
-        # placements for missing + replacements
-        live_count = len([a for a in keep if not a.terminal_status()])
+        # placements for missing + replacements; batch-complete allocs in
+        # ``keep`` count toward desired (their work is done, not missing)
+        live_count = len(keep)
         missing = max(desired - live_count - len(replace), 0)
         name_idx = AllocNameIndex(job.id, tg_name, desired, allocs)
         for prev, penalty in replace:

@@ -49,6 +49,11 @@ class Harness:
             index = self.next_index()
             self.store.upsert_plan_results(index, result, plan.eval_id)
             result.alloc_index = index
+            if result.node_preemptions:
+                from ..broker.plan_apply import preemption_evals
+
+                for ev in preemption_evals(self.store, result):
+                    self.create_eval(ev)
         self.results.append(result)
         new_snap = self.store.snapshot() if result.rejected_nodes else None
         return result, new_snap

@@ -46,9 +46,15 @@ log = logging.getLogger("nomad_tpu.server")
 
 
 class ServerConfig:
-    def __init__(self, num_workers: int = 2, region: str = "global"):
+    def __init__(
+        self,
+        num_workers: int = 2,
+        region: str = "global",
+        heartbeat_ttl: float = 5.0,
+    ):
         self.num_workers = num_workers
         self.region = region
+        self.heartbeat_ttl = heartbeat_ttl
 
 
 class Server:
@@ -58,10 +64,16 @@ class Server:
         self.eval_broker = EvalBroker()
         self.blocked_evals = BlockedEvals(broker=self.eval_broker)
         self.plan_queue = PlanQueue()
-        self.plan_apply_loop = PlanApplyLoop(self.store, self.plan_queue)
+        self.plan_apply_loop = PlanApplyLoop(
+            self.store, self.plan_queue,
+            on_evals_created=self.eval_broker.enqueue_all,
+        )
         self.workers: list[Worker] = []
         self._raft_lock = threading.Lock()
         self._leader = False
+        from .heartbeat import NodeHeartbeater
+
+        self.heartbeater = NodeHeartbeater(self, ttl=self.config.heartbeat_ttl)
         # capacity changes unblock blocked evals (blocked_evals.go:55)
         self.store.add_listener(self._on_state_change)
 
@@ -82,6 +94,7 @@ class Server:
         self.plan_apply_loop.start()
         self.eval_broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
+        self.heartbeater.start()
         self._restore_evals()
         for i in range(self.config.num_workers):
             w = Worker(self, worker_id=i)
@@ -92,6 +105,7 @@ class Server:
         for w in self.workers:
             w.stop()
         self.workers.clear()
+        self.heartbeater.stop()
         self.plan_apply_loop.stop()
         self.plan_queue.set_enabled(False)
         self.eval_broker.set_enabled(False)
@@ -280,6 +294,20 @@ class Server:
             # capacity may have appeared: unblock everything eligible
             self.blocked_evals.unblock(index=index)
 
+    # -- client RPC seam ---------------------------------------------------
+    def client_rpc(self) -> "InProcessClientRPC":
+        return InProcessClientRPC(self)
+
+    def pull_allocs(
+        self, node_id: str, min_index: int, timeout: float = 1.0
+    ) -> tuple[list[Allocation], int]:
+        """Blocking query: the client's alloc pull (node_endpoint.go
+        Node.GetClientAllocs semantics — return once state moves past the
+        client's known index, or on timeout)."""
+        if self.store.latest_index <= min_index:
+            self.store.wait_for_index(min_index + 1, timeout=timeout)
+        return self.store.allocs_by_node(node_id), self.store.latest_index
+
     # -- convenience -------------------------------------------------------
     def wait_for_evals(self, timeout: float = 10.0) -> bool:
         """Test/ops helper: wait until no ready or in-flight evals remain."""
@@ -297,3 +325,28 @@ class Server:
                 return True
             time.sleep(0.01)
         return False
+
+
+class InProcessClientRPC:
+    """The client↔server transport seam, in-process flavor (the reference's
+    msgpack-RPC client/rpc.go collapses to method calls for the dev agent)."""
+
+    def __init__(self, server: Server):
+        self.server = server
+
+    def register_node(self, node) -> None:
+        self.server.register_node(node)
+        self.server.heartbeater.heartbeat(node.id)
+
+    def heartbeat(self, node_id: str) -> float:
+        node = self.server.store.node_by_id(node_id)
+        if node is not None and node.status == "down":
+            # node recovered after missed TTLs (heartbeat.go resurrection)
+            self.server.update_node_status(node_id, "ready")
+        return self.server.heartbeater.heartbeat(node_id)
+
+    def pull_allocs(self, node_id: str, min_index: int, timeout: float):
+        return self.server.pull_allocs(node_id, min_index, timeout)
+
+    def update_allocs(self, updates) -> None:
+        self.server.update_allocs_from_client(updates)

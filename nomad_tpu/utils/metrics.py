@@ -1,0 +1,63 @@
+"""In-process metrics registry.
+
+Reference: armon/go-metrics gauges/timers used throughout the reference
+(`nomad.worker.*` worker.go:461,495,553; `nomad.plan.*` plan_apply.go:185)
+surfaced at /v1/metrics (http.go:333). Counters, gauges and timing
+samples with mean/max, zero dependencies.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._samples: dict[str, list[float]] = {}
+
+    def incr(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def measure(self, name: str, seconds: float) -> None:
+        with self._lock:
+            buf = self._samples.setdefault(name, [])
+            buf.append(seconds)
+            if len(buf) > 1024:
+                del buf[: len(buf) - 1024]
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.measure(name, time.perf_counter() - t0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            samples = {
+                name: {
+                    "count": len(buf),
+                    "mean_ms": (sum(buf) / len(buf)) * 1000 if buf else 0.0,
+                    "max_ms": max(buf) * 1000 if buf else 0.0,
+                }
+                for name, buf in self._samples.items()
+            }
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "samples": samples,
+            }
+
+
+global_metrics = Metrics()

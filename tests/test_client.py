@@ -1,0 +1,188 @@
+"""Client-layer tests: drivers, task/alloc runners, and the full dev-agent
+loop (job → scheduler → client pull → task execution → status sync back).
+Mirrors the reference's client test strategy (mock driver + real hook
+pipelines against temp dirs, SURVEY.md §4.5)."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.client.drivers import MockDriver, RawExecDriver, DriverError
+from nomad_tpu.client.task_runner import TaskRunner
+from nomad_tpu.structs import Task
+from nomad_tpu.structs.job import RestartPolicy
+
+
+def wait_until(cond, timeout=10.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestDrivers:
+    def test_mock_driver_completes(self):
+        d = MockDriver()
+        t = Task(name="t", driver="mock_driver", config={"run_for": 0.05})
+        h = d.start(t, {}, "/tmp")
+        assert d.wait(h) == 0
+        assert h.state == "dead"
+
+    def test_mock_driver_failure(self):
+        d = MockDriver()
+        t = Task(name="t", config={"run_for": 0.01, "exit_code": 2})
+        h = d.start(t, {}, "/tmp")
+        assert d.wait(h) == 2
+
+    def test_mock_driver_start_error(self):
+        d = MockDriver()
+        with pytest.raises(DriverError):
+            d.start(Task(name="t", config={"start_error": "boom"}), {}, "/tmp")
+
+    def test_raw_exec_runs_command(self, tmp_path):
+        d = RawExecDriver()
+        t = Task(
+            name="echo",
+            driver="raw_exec",
+            config={"command": "/bin/sh", "args": ["-c", "echo hello > out.txt"]},
+        )
+        h = d.start(t, {}, str(tmp_path))
+        assert d.wait(h, timeout=5) == 0
+        assert (tmp_path / "out.txt").read_text().strip() == "hello"
+
+    def test_raw_exec_stop_kills(self, tmp_path):
+        d = RawExecDriver()
+        t = Task(
+            name="sleeper",
+            config={"command": "/bin/sleep", "args": ["30"]},
+        )
+        h = d.start(t, {}, str(tmp_path))
+        d.stop(h, kill_timeout=1.0)
+        code = d.wait(h, timeout=5)
+        assert code is not None and code != 0
+
+
+class TestTaskRunner:
+    def test_restart_policy_exhaustion(self, tmp_path):
+        t = Task(name="flaky", config={"run_for": 0.0, "exit_code": 1})
+        tr = TaskRunner(
+            task=t,
+            driver=MockDriver(),
+            task_dir=str(tmp_path),
+            env={},
+            restart_policy=RestartPolicy(attempts=2, interval_s=60, delay_s=0.01),
+        )
+        tr.start()
+        tr.join(timeout=10)
+        assert tr.state.state == "dead"
+        assert tr.state.failed
+        assert tr.state.restarts == 2
+
+    def test_successful_task_no_restart(self, tmp_path):
+        t = Task(name="ok", config={"run_for": 0.01, "exit_code": 0})
+        tr = TaskRunner(
+            task=t, driver=MockDriver(), task_dir=str(tmp_path), env={}
+        )
+        tr.start()
+        tr.join(timeout=10)
+        assert tr.state.state == "dead"
+        assert not tr.state.failed
+        assert tr.state.restarts == 0
+
+
+class TestDevAgent:
+    @pytest.fixture()
+    def agent(self, tmp_path):
+        a = DevAgent(data_dir=str(tmp_path), num_workers=1, heartbeat_ttl=5.0)
+        a.start()
+        yield a
+        a.shutdown()
+
+    def test_end_to_end_batch_job(self, agent):
+        """Full loop: register batch job → placed → client runs it with the
+        mock driver → completes → server sees client_status=complete."""
+        job = mock.batch_job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0].driver = "mock_driver"
+        job.task_groups[0].tasks[0].config = {"run_for": 0.05}
+        agent.register_job(job)
+        assert wait_until(
+            lambda: len(
+                [
+                    a
+                    for a in agent.store.allocs_by_job(job.namespace, job.id)
+                    if a.client_status == "complete"
+                ]
+            )
+            == 2,
+            timeout=15,
+        ), "batch allocs should run to completion"
+
+    def test_end_to_end_raw_exec(self, agent):
+        job = mock.batch_job()
+        job.task_groups[0].count = 1
+        t = job.task_groups[0].tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh", "args": ["-c", "echo ran > $NOMAD_TASK_DIR/proof"]}
+        agent.register_job(job)
+        assert wait_until(
+            lambda: any(
+                a.client_status == "complete"
+                for a in agent.store.allocs_by_job(job.namespace, job.id)
+            ),
+            timeout=15,
+        )
+        # the task actually wrote through its task dir
+        a = agent.store.allocs_by_job(job.namespace, job.id)[0]
+        proof = os.path.join(
+            agent.data_dir, "allocs", a.id, t.name, "local", "proof"
+        )
+        assert os.path.exists(proof)
+
+    def test_service_job_runs_and_stops(self, agent):
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0].driver = "mock_driver"
+        job.task_groups[0].tasks[0].config = {"run_for": 300}
+        agent.register_job(job)
+        assert wait_until(
+            lambda: len(
+                [
+                    a
+                    for a in agent.store.allocs_by_job(job.namespace, job.id)
+                    if a.client_status == "running"
+                ]
+            )
+            == 2,
+            timeout=15,
+        )
+        assert agent.client.num_allocs() == 2
+        agent.deregister_job(job.namespace, job.id)
+        assert wait_until(
+            lambda: all(
+                a.client_status in ("complete", "failed")
+                for a in agent.store.allocs_by_job(job.namespace, job.id)
+            ),
+            timeout=15,
+        ), "stopped allocs should terminate on the client"
+
+    def test_failed_task_reported(self, agent):
+        job = mock.batch_job()
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].driver = "mock_driver"
+        job.task_groups[0].tasks[0].config = {"run_for": 0.01, "exit_code": 3}
+        job.task_groups[0].restart_policy.attempts = 0
+        job.task_groups[0].restart_policy.mode = "fail"
+        agent.register_job(job)
+        assert wait_until(
+            lambda: any(
+                a.client_status == "failed"
+                for a in agent.store.allocs_by_job(job.namespace, job.id)
+            ),
+            timeout=15,
+        )

@@ -1,0 +1,174 @@
+"""Preemption tests — the analog of scheduler/preemption_test.go: priority
+delta eligibility, minimal low-priority victim selection, and end-to-end
+eviction through the plan applier."""
+
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.device import flatten_cluster
+from nomad_tpu.device.preempt import build_victim_tensors, find_preemptions
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.state import StateStore, SchedulerConfiguration
+from nomad_tpu.structs import ALLOC_DESIRED_EVICT
+from nomad_tpu.structs.resources import NodeResources
+
+
+def cluster_with_load(n_nodes, jobs_priorities, per_node):
+    """Fill every node with `per_node` allocs from jobs at given priorities."""
+    s = StateStore()
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for i, n in enumerate(nodes):
+        s.upsert_node(i + 1, n)
+    idx = 100
+    filler_jobs = []
+    for prio in jobs_priorities:
+        j = mock.job(priority=prio)
+        j.task_groups[0].tasks[0].resources.cpu = 1800
+        j.task_groups[0].tasks[0].resources.memory_mb = 3500
+        filler_jobs.append(j)
+        s.upsert_job(idx, j)
+        idx += 1
+    allocs = []
+    for n in nodes:
+        for k in range(per_node):
+            j = filler_jobs[k % len(filler_jobs)]
+            allocs.append(mock.alloc(j, n))
+    s.upsert_allocs(idx, allocs)
+    return s, nodes, filler_jobs
+
+
+class TestVictimSelection:
+    def test_priority_delta_rule(self):
+        """Only victims at priority ≤ preemptor − 10 are candidates
+        (preemption.go:663-697)."""
+        s, nodes, _ = cluster_with_load(1, [45], 2)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        high = mock.job(priority=50)  # delta 5 < 10: not allowed
+        _, _, mask, _ = build_victim_tensors(ct, snap, high)
+        assert not mask.any()
+        higher = mock.job(priority=60)  # delta 15: allowed
+        _, prio, mask, _ = build_victim_tensors(ct, snap, higher)
+        assert mask.sum() == 2
+
+    def test_minimal_lowest_priority_victims(self):
+        """Victims are taken lowest-priority-first and only as many as
+        needed (PreemptForTaskGroup :198-265)."""
+        # node: 3900 cpu cap; two fillers at 1800 → used 3600, free 300
+        s, nodes, fillers = cluster_with_load(1, [20, 40], 2)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        job = mock.job(priority=70)
+        ask = np.array([1000.0, 256.0, 300.0, 0.0], dtype=np.float32)
+        eligible = ct.ready.copy()
+        row, victim_ids = find_preemptions(ct, snap, job, ask, eligible)
+        assert row == 0
+        assert len(victim_ids) == 1  # one eviction frees 1800 ≥ 700 shortfall
+        victim = snap.alloc_by_id(victim_ids[0])
+        assert victim.job.priority == 20  # the lowest-priority one
+
+    def test_no_preemption_when_infeasible(self):
+        """Even evicting everything can't fit an oversized ask."""
+        s, nodes, _ = cluster_with_load(1, [20], 2)
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        job = mock.job(priority=70)
+        ask = np.array([99999.0, 256.0, 300.0, 0.0], dtype=np.float32)
+        row, victims = find_preemptions(ct, snap, job, ask, ct.ready.copy())
+        assert row is None and victims == []
+
+
+class TestPreemptionEndToEnd:
+    def test_high_priority_job_preempts(self):
+        h = Harness()
+        h.store.set_scheduler_config(
+            1, SchedulerConfiguration(preemption_service_enabled=True)
+        )
+        nodes = [mock.node() for _ in range(2)]
+        for i, n in enumerate(nodes):
+            h.store.upsert_node(i + 2, n)
+        # fill the cluster with low-priority ballast
+        low = mock.job(priority=10)
+        low.task_groups[0].count = 4
+        low.task_groups[0].tasks[0].resources.cpu = 1800
+        low.task_groups[0].tasks[0].resources.memory_mb = 3500
+        h.store.upsert_job(10, low)
+        h.process(mock.eval_for(low))
+        assert (
+            len(
+                [
+                    a
+                    for a in h.store.allocs_by_job(low.namespace, low.id)
+                    if not a.terminal_status()
+                ]
+            )
+            == 4
+        )
+        # high-priority job arrives; cluster is full
+        high = mock.job(priority=90)
+        high.task_groups[0].count = 1
+        high.task_groups[0].tasks[0].resources.cpu = 2000
+        high.task_groups[0].tasks[0].resources.memory_mb = 1024
+        h.store.upsert_job(20, high)
+        h.process(mock.eval_for(high))
+        placed = [
+            a
+            for a in h.store.allocs_by_job(high.namespace, high.id)
+            if not a.terminal_status()
+        ]
+        assert len(placed) == 1
+        assert placed[0].preempted_allocations
+        evicted = [
+            h.store.alloc_by_id(vid) for vid in placed[0].preempted_allocations
+        ]
+        assert all(v.desired_status == ALLOC_DESIRED_EVICT for v in evicted)
+        assert all(v.preempted_by_allocation == placed[0].id for v in evicted)
+
+    def test_preemption_creates_victim_job_evals(self):
+        """The applier rolls follow-up evals for preempted jobs
+        (plan_apply.go PreemptionEvals) so victims re-place elsewhere."""
+        h = Harness()
+        h.store.set_scheduler_config(
+            1, SchedulerConfiguration(preemption_service_enabled=True)
+        )
+        h.store.upsert_node(2, mock.node())
+        low = mock.job(priority=10)
+        low.task_groups[0].count = 2
+        low.task_groups[0].tasks[0].resources.cpu = 1800
+        low.task_groups[0].tasks[0].resources.memory_mb = 3500
+        h.store.upsert_job(10, low)
+        h.process(mock.eval_for(low))
+        high = mock.job(priority=90)
+        high.task_groups[0].count = 1
+        high.task_groups[0].tasks[0].resources.cpu = 2000
+        h.store.upsert_job(20, high)
+        h.process(mock.eval_for(high))
+        followups = [
+            e
+            for e in h.created_evals
+            if e.triggered_by == "preemption" and e.job_id == low.id
+        ]
+        assert len(followups) == 1
+
+    def test_preemption_disabled_blocks_instead(self):
+        h = Harness()  # default config: service preemption disabled
+        n = mock.node()
+        h.store.upsert_node(2, n)
+        low = mock.job(priority=10)
+        low.task_groups[0].count = 2
+        low.task_groups[0].tasks[0].resources.cpu = 1800
+        low.task_groups[0].tasks[0].resources.memory_mb = 3500
+        h.store.upsert_job(10, low)
+        h.process(mock.eval_for(low))
+        high = mock.job(priority=90)
+        high.task_groups[0].count = 1
+        high.task_groups[0].tasks[0].resources.cpu = 2000
+        h.store.upsert_job(20, high)
+        h.process(mock.eval_for(high))
+        placed = [
+            a
+            for a in h.store.allocs_by_job(high.namespace, high.id)
+            if not a.terminal_status()
+        ]
+        assert placed == []
+        assert len(h.created_evals) == 1  # blocked eval instead
