@@ -81,6 +81,10 @@ class NomadClient:
         return Evaluations(self)
 
     @property
+    def deployments(self) -> "Deployments":
+        return Deployments(self)
+
+    @property
     def operator(self) -> "Operator":
         return Operator(self)
 
@@ -116,6 +120,25 @@ class Jobs:
 
     def summary(self, job_id: str, namespace: str = "default"):
         return self.c.get(f"/v1/job/{job_id}/summary", namespace=namespace)
+
+    def dispatch(
+        self, job_id: str, payload: bytes = b"", meta=None, namespace: str = "default"
+    ):
+        import base64
+
+        return self.c.post(
+            f"/v1/job/{job_id}/dispatch",
+            {
+                "payload": base64.b64encode(payload).decode(),
+                "meta": meta or {},
+            },
+            namespace=namespace,
+        )
+
+    def periodic_force(self, job_id: str, namespace: str = "default"):
+        return self.c.post(
+            f"/v1/job/{job_id}/periodic/force", namespace=namespace
+        )
 
 
 class Nodes:
@@ -166,12 +189,35 @@ class Evaluations:
         return self.c.get(f"/v1/evaluation/{eval_id}")
 
 
+class Deployments:
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def list(self):
+        return self.c.get("/v1/deployments")
+
+    def info(self, deployment_id: str):
+        return self.c.get(f"/v1/deployment/{deployment_id}")
+
+    def for_job(self, job_id: str, namespace: str = "default"):
+        return self.c.get(f"/v1/job/{job_id}/deployments", namespace=namespace)
+
+    def promote(self, deployment_id: str):
+        return self.c.post(f"/v1/deployment/promote/{deployment_id}")
+
+    def fail(self, deployment_id: str):
+        return self.c.post(f"/v1/deployment/fail/{deployment_id}")
+
+
 class Operator:
     def __init__(self, c: NomadClient):
         self.c = c
 
     def scheduler_config(self):
         return self.c.get("/v1/operator/scheduler/configuration")
+
+    def snapshot_save(self, path: str):
+        return self.c.post("/v1/operator/snapshot/save", {"path": path})
 
     def set_scheduler_config(self, **kwargs):
         return self.c.post("/v1/operator/scheduler/configuration", kwargs)

@@ -34,6 +34,13 @@ class APIError(Exception):
         self.message = message
 
 
+class StreamingResponse:
+    """Marker for NDJSON streaming handlers (/v1/event/stream)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+
 class HTTPAgent:
     """Routes + handlers bound to a Server (and optionally a Client)."""
 
@@ -74,6 +81,23 @@ class HTTPAgent:
                 re.compile(r"^/v1/node/(?P<node_id>[^/]+)/allocations$"),
                 self.handle_node_allocs,
             ),
+            (
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/deployments$"),
+                self.handle_job_deployments,
+            ),
+            (re.compile(r"^/v1/deployments$"), self.handle_deployments),
+            (
+                re.compile(r"^/v1/deployment/promote/(?P<deployment_id>[^/]+)$"),
+                self.handle_deployment_promote,
+            ),
+            (
+                re.compile(r"^/v1/deployment/fail/(?P<deployment_id>[^/]+)$"),
+                self.handle_deployment_fail,
+            ),
+            (
+                re.compile(r"^/v1/deployment/(?P<deployment_id>[^/]+)$"),
+                self.handle_deployment,
+            ),
             (re.compile(r"^/v1/allocations$"), self.handle_allocs),
             (
                 re.compile(r"^/v1/allocation/(?P<alloc_id>[^/]+)$"),
@@ -87,6 +111,19 @@ class HTTPAgent:
             (
                 re.compile(r"^/v1/operator/scheduler/configuration$"),
                 self.handle_scheduler_config,
+            ),
+            (
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/dispatch$"),
+                self.handle_job_dispatch,
+            ),
+            (
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/periodic/force$"),
+                self.handle_periodic_force,
+            ),
+            (re.compile(r"^/v1/event/stream$"), self.handle_event_stream),
+            (
+                re.compile(r"^/v1/operator/snapshot/save$"),
+                self.handle_snapshot_save,
             ),
             (re.compile(r"^/v1/agent/self$"), self.handle_agent_self),
             (re.compile(r"^/v1/status/leader$"), self.handle_leader),
@@ -129,7 +166,10 @@ class HTTPAgent:
                         except Exception as e:  # noqa: BLE001
                             self._reply(500, {"error": str(e)})
                         else:
-                            self._reply(200, result)
+                            if isinstance(result, StreamingResponse):
+                                self._stream(result.iterator)
+                            else:
+                                self._reply(200, result)
                         return
                 self._reply(404, {"error": f"no handler for {parsed.path}"})
 
@@ -143,6 +183,25 @@ class HTTPAgent:
                 )
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _stream(self, iterator):
+                """NDJSON chunked streaming (nomad/stream/ndjson.go)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_chunk(data: bytes):
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for line in iterator:
+                        write_chunk(line.encode() + b"\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
 
             def do_GET(self):
                 self._dispatch("GET")
@@ -280,6 +339,52 @@ class HTTPAgent:
                 if tg in summary:
                     summary[tg]["queued"] = max(summary[tg]["queued"], n)
         return {"job_id": job.id, "summary": summary}
+
+    def handle_job_deployments(self, method, body, query, job_id):
+        job = self._get_job(job_id, query)
+        return [
+            encode(d)
+            for d in self.server.store.deployments()
+            if d.job_id == job.id and d.namespace == job.namespace
+        ]
+
+    def handle_deployments(self, method, body, query):
+        self._maybe_block(query)
+        return [encode(d) for d in self.server.store.deployments()]
+
+    def _get_deployment(self, deployment_id):
+        d = self.server.store.deployment_by_id(deployment_id)
+        if d is None:
+            matches = [
+                x
+                for x in self.server.store.deployments()
+                if x.id.startswith(deployment_id)
+            ]
+            if len(matches) != 1:
+                raise APIError(404, f"deployment {deployment_id} not found")
+            d = matches[0]
+        return d
+
+    def handle_deployment(self, method, body, query, deployment_id):
+        return encode(self._get_deployment(deployment_id))
+
+    def handle_deployment_promote(self, method, body, query, deployment_id):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        d = self._get_deployment(deployment_id)
+        ok = self.server.deployment_watcher.promote(d.id)
+        if not ok:
+            raise APIError(400, "deployment is not active")
+        return {"promoted": True}
+
+    def handle_deployment_fail(self, method, body, query, deployment_id):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        d = self._get_deployment(deployment_id)
+        ok = self.server.deployment_watcher.fail(d.id)
+        if not ok:
+            raise APIError(400, "deployment is not active")
+        return {"failed": True}
 
     def handle_nodes(self, method, body, query):
         self._maybe_block(query)
@@ -425,6 +530,73 @@ class HTTPAgent:
             )
             return {"updated": True}
         raise APIError(405, f"method {method} not allowed")
+
+    def handle_job_dispatch(self, method, body, query, job_id):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        body = body or {}
+        ns = query.get("namespace", "default")
+        import base64
+
+        payload = base64.b64decode(body.get("payload", "") or "")
+        try:
+            child, ev = self.server.dispatch_job(
+                ns, job_id, payload=payload, meta=body.get("meta") or {}
+            )
+        except ValueError as e:
+            raise APIError(400, str(e)) from None
+        return {"dispatched_job_id": child.id, "eval_id": ev.id}
+
+    def handle_periodic_force(self, method, body, query, job_id):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        job = self._get_job(job_id, query)
+        if not job.is_periodic():
+            raise APIError(400, f"job {job_id} is not periodic")
+        child = self.server.periodic.force_launch(job)
+        if child is None:
+            raise APIError(400, "launch skipped (prohibit_overlap)")
+        return {"launched_job_id": child.id}
+
+    def handle_event_stream(self, method, body, query):
+        """NDJSON event stream (http.go:359 /v1/event/stream)."""
+        from_index = int(query.get("index", 0) or 0)
+        topics = None
+        if "topic" in query:
+            # topic=Job:* or topic=Node:node-id
+            topics = {}
+            for spec in query["topic"].split(","):
+                topic, _, key = spec.partition(":")
+                topics.setdefault(topic, []).append(key or "*")
+        limit = int(query.get("limit", 0) or 0)  # test hook: stop after N
+        sub = self.server.events.subscribe(topics, from_index)
+
+        def gen():
+            n = 0
+            deadline = None
+            wait = float(query.get("wait", 30.0) or 30.0)
+            import time as _t
+
+            deadline = _t.time() + wait
+            while _t.time() < deadline:
+                for ev in sub.next_events(timeout=0.5):
+                    yield ev.to_json()
+                    n += 1
+                    if limit and n >= limit:
+                        return
+
+        return StreamingResponse(gen())
+
+    def handle_snapshot_save(self, method, body, query):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        path = (body or {}).get("path")
+        if not path:
+            raise APIError(400, "missing 'path'")
+        from ..state.snapshot import save_snapshot
+
+        index = save_snapshot(self.server.store, path)
+        return {"index": index, "path": path}
 
     def handle_agent_self(self, method, body, query):
         out = {

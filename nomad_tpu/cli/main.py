@@ -235,6 +235,61 @@ def cmd_eval_status(args) -> int:
     return 0
 
 
+def cmd_deployment_list(args) -> int:
+    c = _client(args)
+    deployments = c.deployments.list()
+    print(f"{'ID':<10} {'Job':<25} {'Version':<8} {'Status':<12} Description")
+    for d in deployments:
+        print(
+            f"{d['id'][:8]:<10} {d['job_id'][:23]:<25} {d['job_version']:<8} "
+            f"{d['status']:<12} {d['status_description']}"
+        )
+    return 0
+
+
+def cmd_deployment_status(args) -> int:
+    c = _client(args)
+    try:
+        d = c.deployments.info(args.deployment_id)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"ID          = {d['id']}")
+    print(f"Job ID      = {d['job_id']}")
+    print(f"Job Version = {d['job_version']}")
+    print(f"Status      = {d['status']}")
+    print(f"Description = {d['status_description']}")
+    print("\nDeployed")
+    print(f"{'Group':<15} {'Auto':<6} {'Promoted':<9} {'Desired':<8} {'Canaries':<9} {'Placed':<7} {'Healthy':<8} {'Unhealthy':<9}")
+    for name, s in d.get("task_groups", {}).items():
+        print(
+            f"{name:<15} {str(s['auto_promote']).lower():<6} "
+            f"{str(s['promoted']).lower():<9} {s['desired_total']:<8} "
+            f"{s['desired_canaries']:<9} {s['placed_allocs']:<7} "
+            f"{s['healthy_allocs']:<8} {s['unhealthy_allocs']:<9}"
+        )
+    return 0
+
+
+def cmd_deployment_promote(args) -> int:
+    c = _client(args)
+    try:
+        c.deployments.promote(args.deployment_id)
+    except APIException as e:
+        return _fail(str(e))
+    print("==> deployment promoted")
+    return 0
+
+
+def cmd_deployment_fail(args) -> int:
+    c = _client(args)
+    try:
+        c.deployments.fail(args.deployment_id)
+    except APIException as e:
+        return _fail(str(e))
+    print("==> deployment failed")
+    return 0
+
+
 def cmd_operator_scheduler(args) -> int:
     c = _client(args)
     if args.algorithm:
@@ -309,6 +364,21 @@ def build_parser() -> argparse.ArgumentParser:
     estatus = ev.add_parser("status")
     estatus.add_argument("eval_id")
     estatus.set_defaults(fn=cmd_eval_status)
+
+    dep = sub.add_parser("deployment", help="deployment commands").add_subparsers(
+        dest="sub", required=True
+    )
+    dlist = dep.add_parser("list")
+    dlist.set_defaults(fn=cmd_deployment_list)
+    dstatus = dep.add_parser("status")
+    dstatus.add_argument("deployment_id")
+    dstatus.set_defaults(fn=cmd_deployment_status)
+    dpromote = dep.add_parser("promote")
+    dpromote.add_argument("deployment_id")
+    dpromote.set_defaults(fn=cmd_deployment_promote)
+    dfail = dep.add_parser("fail")
+    dfail.add_argument("deployment_id")
+    dfail.set_defaults(fn=cmd_deployment_fail)
 
     op = sub.add_parser("operator", help="operator commands").add_subparsers(
         dest="sub", required=True

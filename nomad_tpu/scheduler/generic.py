@@ -133,13 +133,49 @@ class GenericScheduler:
 
         existing = self.snapshot.allocs_by_job(ev.namespace, ev.job_id)
         tainted = tainted_nodes(self.snapshot, existing)
+        deployment = self.snapshot.latest_deployment_by_job(
+            ev.namespace, ev.job_id
+        )
         results = reconcile(
             self.job,
             ev.job_id,
             existing,
             tainted,
             batch=self.batch,
+            deployment=deployment,
         )
+
+        # deployment lifecycle (reconcile.go + deploymentwatcher semantics):
+        # create one for a gated rollout; cancel one superseded by a newer
+        # job version
+        self.deployment = None
+        if deployment is not None and deployment.active() and self.job is not None:
+            if deployment.job_version == self.job.version:
+                self.deployment = deployment
+            else:
+                from ..structs.deployment import DESC_NEW_VERSION
+
+                self.plan.deployment_updates.append(
+                    {
+                        "deployment_id": deployment.id,
+                        "status": "cancelled",
+                        "description": DESC_NEW_VERSION,
+                    }
+                )
+        if results.deployment_states and self.job is not None:
+            from ..structs.deployment import Deployment
+
+            now = time.time()
+            for s in results.deployment_states.values():
+                s.require_progress_by_unix = now + s.progress_deadline_s
+            new_d = Deployment(
+                namespace=self.job.namespace,
+                job_id=self.job.id,
+                job_version=self.job.version,
+                task_groups=dict(results.deployment_states),
+            )
+            self.plan.deployment = new_d
+            self.deployment = new_d
 
         # stops
         for stop in results.stop:
@@ -310,6 +346,11 @@ class GenericScheduler:
                     client_status="pending",
                     metrics=metric,
                 )
+                if self.deployment is not None and tg_name in (
+                    self.deployment.task_groups
+                ):
+                    alloc.deployment_id = self.deployment.id
+                    alloc.canary = pr.canary
                 if pr.previous_alloc is not None:
                     alloc.previous_allocation = pr.previous_alloc.id
                     prev = pr.previous_alloc

@@ -76,6 +76,9 @@ class ReconcileResults:
     # followup evals with wait_until (generic_sched.go:718-753)
     disconnect_followups: list[tuple[Allocation, float]] = field(default_factory=list)
     desired_tg_updates: dict[str, dict] = field(default_factory=dict)
+    # groups that need a (new) deployment to track their rollout:
+    # tg name → DeploymentState template (reconcile.go's deployment logic)
+    deployment_states: dict[str, object] = field(default_factory=dict)
 
 
 def tasks_updated(old_job: Job, new_job: Job, group_name: str) -> bool:
@@ -149,11 +152,16 @@ def reconcile(
     *,
     batch: bool = False,
     now_ns: Optional[int] = None,
+    deployment=None,
 ) -> ReconcileResults:
     """Compute the diff for one job.
 
     ``job`` None or stopped ⇒ stop everything. ``tainted_nodes`` maps node
     id → Node for down/draining nodes (scheduler/util.go:354 taintedNodes).
+    ``deployment`` is the job's latest deployment (if any): groups with an
+    update strategy gate their destructive replacements on it — canaries
+    first, then at most ``max_parallel`` in-flight unhealthy replacements
+    (reconcile.go's deployment-aware computeGroup logic).
     """
     r = ReconcileResults()
     now_ns = now_ns if now_ns is not None else time.time_ns()
@@ -242,12 +250,45 @@ def reconcile(
 
             keep.append(a)
 
+        # deployment gating context for this group
+        u = tg.update
+        dstate = (
+            deployment.task_groups.get(tg_name)
+            if deployment is not None
+            and deployment.active()
+            and deployment.job_version == job.version
+            else None
+        )
+        # a FAILED deployment for this very version halts the rollout:
+        # no further replacements, no fresh deployment — until a new job
+        # version (e.g. auto-revert) arrives (deploymentwatcher semantics)
+        rollout_halted = (
+            deployment is not None
+            and deployment.job_version == job.version
+            and deployment.status == "failed"
+        )
+        # unpromoted canaries run *beside* the old version: they don't
+        # count toward desired and must not trigger surplus stops
+        canaries: list[Allocation] = []
+        if u is not None and u.canary > 0 and (
+            dstate is None or not dstate.promoted
+        ):
+            canaries = [
+                a for a in keep if a.canary and a.job_version == job.version
+            ]
+            keep = [a for a in keep if a not in canaries]
+
         # count adjustment over the kept (healthy, untainted) allocs
         n_target = desired - len(replace)
         if len(keep) > n_target:
-            # stop surplus: highest name indices first (allocNameIndex)
+            # stop surplus: old-version allocs first (a promoted canary on
+            # the new version must survive the count convergence), then
+            # highest name indices (allocNameIndex)
             surplus = len(keep) - max(n_target, 0)
-            keep_sorted = sorted(keep, key=lambda a: a.index(), reverse=True)
+            keep_sorted = sorted(
+                keep,
+                key=lambda a: (a.job_version == job.version, -a.index()),
+            )
             for a in keep_sorted[:surplus]:
                 if a.terminal_status():
                     continue
@@ -259,6 +300,7 @@ def reconcile(
         # the verdict is cached per old job *version* (allocs in one group
         # can sit on different stale versions with different diffs)
         updated_by_version: dict[int, bool] = {}
+        destructive_candidates: list[tuple[Allocation, PlaceRequest]] = []
         for a in keep:
             if a.job_version == job.version or a.terminal_status():
                 r.ignore.append(a)
@@ -271,17 +313,103 @@ def reconcile(
                 )
             if updated_by_version[a.job_version]:
                 pr = PlaceRequest(name=a.name, task_group=tg, previous_alloc=a)
-                r.destructive_update.append((a, pr))
-                counts["destructive_update"] += 1
+                destructive_candidates.append((a, pr))
             else:
                 r.inplace_update.append(UpdateRequest(a, job))
                 counts["in_place_update"] += 1
+
+        # rollout gating (reconcile.go computeGroup): with an update
+        # strategy, destructive replacements are throttled by the
+        # deployment's health signal instead of happening all at once
+        if rollout_halted and u is not None:
+            for a, _pr in destructive_candidates:
+                r.ignore.append(a)
+                counts["ignore"] += 1
+            destructive_candidates = []
+        canary_phase = (
+            u is not None
+            and u.canary > 0
+            and destructive_candidates
+            and (dstate is None or not dstate.promoted)
+        )
+        if canary_phase:
+            # canary phase: place missing canaries, leave old version alone
+            need = u.canary - len(
+                [a for a in canaries if not a.terminal_status()]
+            )
+            cname_idx = AllocNameIndex(job.id, tg_name, desired, allocs)
+            for name in cname_idx.next(max(need, 0)):
+                r.place.append(
+                    PlaceRequest(name=name, task_group=tg, canary=True)
+                )
+                counts["place"] += 1
+            for a, _pr in destructive_candidates:
+                r.ignore.append(a)
+                counts["ignore"] += 1
+            destructive_candidates = []
+        elif (
+            u is not None and u.rolling() and destructive_candidates
+        ):
+            current = [
+                a
+                for a in keep + canaries
+                if a.job_version == job.version and not a.terminal_status()
+            ]
+            healthy = len(
+                [
+                    a
+                    for a in current
+                    if a.deployment_status is not None
+                    and a.deployment_status.is_healthy()
+                ]
+            )
+            in_flight = len(current) - healthy
+            budget = max(u.max_parallel - in_flight, 0)
+            deferred = destructive_candidates[budget:]
+            destructive_candidates = destructive_candidates[:budget]
+            for a, _pr in deferred:
+                r.ignore.append(a)
+                counts["ignore"] += 1
+
+        for a, pr in destructive_candidates:
+            r.destructive_update.append((a, pr))
+            counts["destructive_update"] += 1
+
+        # signal that this rollout needs deployment tracking
+        if (
+            not rollout_halted
+            and u is not None
+            and u.rolling()
+            and (destructive_candidates or canary_phase or dstate is None)
+            and (
+                deployment is None
+                or not deployment.active()
+                or deployment.job_version != job.version
+            )
+            and (destructive_candidates or canary_phase or job.version > 0)
+        ):
+            from ..structs.deployment import DeploymentState
+
+            r.deployment_states[tg_name] = DeploymentState(
+                auto_revert=u.auto_revert,
+                auto_promote=u.auto_promote,
+                desired_canaries=u.canary if canary_phase else 0,
+                desired_total=desired,
+                progress_deadline_s=u.progress_deadline_s,
+            )
 
         # placements for missing + replacements; batch-complete allocs in
         # ``keep`` count toward desired (their work is done, not missing)
         live_count = len(keep)
         missing = max(desired - live_count - len(replace), 0)
-        name_idx = AllocNameIndex(job.id, tg_name, desired, allocs)
+        # terminal allocs release their name index for reuse
+        # (reconcile_util.go allocNameIndex tracks live names only)
+        name_idx = AllocNameIndex(
+            job.id,
+            tg_name,
+            desired,
+            [a for a in allocs if not a.terminal_status()],
+        )
         for prev, penalty in replace:
             r.place.append(
                 PlaceRequest(

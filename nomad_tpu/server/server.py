@@ -51,10 +51,12 @@ class ServerConfig:
         num_workers: int = 2,
         region: str = "global",
         heartbeat_ttl: float = 5.0,
+        deployment_watch_interval: float = 0.25,
     ):
         self.num_workers = num_workers
         self.region = region
         self.heartbeat_ttl = heartbeat_ttl
+        self.deployment_watch_interval = deployment_watch_interval
 
 
 class Server:
@@ -71,11 +73,35 @@ class Server:
         self.workers: list[Worker] = []
         self._raft_lock = threading.Lock()
         self._leader = False
+        from ..broker.event_broker import EventBroker as StreamBroker
+        from .core_gc import CoreScheduler
+        from .deployment_watcher import DeploymentWatcher
         from .heartbeat import NodeHeartbeater
+        from .periodic import PeriodicDispatch
 
         self.heartbeater = NodeHeartbeater(self, ttl=self.config.heartbeat_ttl)
+        self.deployment_watcher = DeploymentWatcher(
+            self, interval=self.config.deployment_watch_interval
+        )
+        self.periodic = PeriodicDispatch(self)
+        self.core_gc = CoreScheduler(self)
+        self.events = StreamBroker()
         # capacity changes unblock blocked evals (blocked_evals.go:55)
         self.store.add_listener(self._on_state_change)
+
+    @classmethod
+    def from_snapshot(cls, path: str, config: Optional[ServerConfig] = None):
+        """Boot a server from a saved state snapshot (the restore half of
+        checkpoint/resume; nomadFSM.Restore + leader queue restoration)."""
+        from ..state.snapshot import restore_snapshot
+
+        server = cls(config)
+        restored = restore_snapshot(path)
+        # swap the fresh store for the restored one, rewiring listeners
+        server.store = restored
+        server.plan_apply_loop.applier.store = restored
+        server.store.add_listener(server._on_state_change)
+        return server
 
     # -- raft seam ---------------------------------------------------------
     def _raft_apply(self, fn) -> int:
@@ -95,6 +121,10 @@ class Server:
         self.eval_broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
         self.heartbeater.start()
+        self.deployment_watcher.start()
+        self.periodic.restore()
+        self.periodic.start()
+        self.core_gc.start()
         self._restore_evals()
         for i in range(self.config.num_workers):
             w = Worker(self, worker_id=i)
@@ -106,6 +136,9 @@ class Server:
             w.stop()
         self.workers.clear()
         self.heartbeater.stop()
+        self.deployment_watcher.stop()
+        self.periodic.stop()
+        self.core_gc.stop()
         self.plan_apply_loop.stop()
         self.plan_queue.set_enabled(False)
         self.eval_broker.set_enabled(False)
@@ -129,6 +162,9 @@ class Server:
     def register_job(self, job: Job) -> Evaluation:
         """Job.Register (nomad/job_endpoint.go): upsert job + create eval
         in one commit, then enqueue."""
+        # periodic/parameterized jobs are templates: no eval until a child
+        # is derived (job_endpoint.go Register skips eval creation for them)
+        needs_eval = not job.is_periodic() and not job.is_parameterized()
         ev = Evaluation(
             namespace=job.namespace,
             priority=job.priority,
@@ -140,14 +176,57 @@ class Server:
 
         def apply(index):
             self.store.upsert_job(index, job)
-            ev.job_modify_index = index
-            self.store.upsert_evals(index, [ev])
+            if needs_eval:
+                ev.job_modify_index = index
+                self.store.upsert_evals(index, [ev])
 
         self._raft_apply(apply)
         self.blocked_evals.untrack(job.namespace, job.id)
-        if not job.is_periodic() and not job.is_parameterized():
+        self._publish(
+            "Job", "JobRegistered", job.id, job.namespace, {"job_id": job.id}
+        )
+        if job.is_periodic():
+            self.periodic.add(job)
+        if needs_eval:
             self.eval_broker.enqueue(ev)
         return ev
+
+    def dispatch_job(
+        self, namespace: str, job_id: str, payload: bytes = b"", meta=None
+    ):
+        """Dispatch a parameterized job: derive a one-shot child
+        (nomad/job_endpoint.go Job.Dispatch)."""
+        import copy as _copy
+        import time as _t
+
+        parent = self.store.job_by_id(namespace, job_id)
+        if parent is None or not parent.is_parameterized():
+            raise ValueError(f"job {job_id} is not parameterized")
+        cfg = parent.parameterized
+        meta = dict(meta or {})
+        missing = [k for k in cfg.meta_required if k not in meta]
+        if missing:
+            raise ValueError(f"missing required dispatch meta: {missing}")
+        unknown = [
+            k
+            for k in meta
+            if k not in cfg.meta_required and k not in cfg.meta_optional
+        ]
+        if unknown:
+            raise ValueError(f"dispatch meta not allowed: {unknown}")
+        if cfg.payload == "required" and not payload:
+            raise ValueError("dispatch payload is required")
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("dispatch payload is forbidden")
+        child = _copy.deepcopy(parent)
+        child.id = f"{parent.id}/dispatch-{int(_t.time())}-{new_id()[:8]}"
+        child.name = child.id
+        child.parameterized = None
+        child.parent_id = parent.id
+        child.payload = payload
+        child.meta = {**parent.meta, **meta}
+        ev = self.register_job(child)
+        return child, ev
 
     def deregister_job(self, namespace: str, job_id: str) -> Optional[Evaluation]:
         job = self.store.job_by_id(namespace, job_id)
@@ -172,18 +251,28 @@ class Server:
 
         self._raft_apply(apply)
         self.blocked_evals.untrack(namespace, job_id)
+        self.periodic.remove(namespace, job_id)
+        self._publish(
+            "Job", "JobDeregistered", job_id, namespace, {"job_id": job_id}
+        )
         self.eval_broker.enqueue(ev)
         return ev
 
     # -- API: nodes --------------------------------------------------------
     def register_node(self, node: Node) -> None:
         self._raft_apply(lambda index: self.store.upsert_node(index, node))
+        self._publish(
+            "Node", "NodeRegistration", node.id, "default", {"node_id": node.id}
+        )
 
     def update_node_status(self, node_id: str, status: str) -> list[Evaluation]:
         """Node.UpdateStatus: commit + fan out node-update evals for every
         job with allocs on the node (nomad/node_endpoint.go createNodeEvals)."""
         self._raft_apply(
             lambda index: self.store.update_node_status(index, node_id, status)
+        )
+        self._publish(
+            "Node", "NodeStatusUpdate", node_id, "default", {"status": status}
         )
         return self._create_node_evals(node_id)
 
@@ -239,6 +328,14 @@ class Server:
         self._raft_apply(
             lambda index: self.store.update_allocs_from_client(index, updates)
         )
+        for u in updates:
+            self._publish(
+                "Allocation",
+                "AllocationClientUpdated",
+                u.id,
+                u.namespace,
+                {"client_status": u.client_status, "job_id": u.job_id},
+            )
         # terminal client statuses free capacity ⇒ unblock held evals
         if any(
             u.client_status in ("complete", "failed", "lost") for u in updates
@@ -293,6 +390,16 @@ class Server:
         if table == "nodes":
             # capacity may have appeared: unblock everything eligible
             self.blocked_evals.unblock(index=index)
+
+    def _publish(
+        self, topic: str, type_: str, key: str, namespace: str, payload: dict
+    ) -> None:
+        from ..broker.event_broker import Event
+
+        self.events.publish(
+            [Event(topic=topic, type=type_, key=key, namespace=namespace, payload=payload)],
+            self.store.latest_index,
+        )
 
     # -- client RPC seam ---------------------------------------------------
     def client_rpc(self) -> "InProcessClientRPC":

@@ -1,0 +1,69 @@
+"""State snapshot persistence — checkpoint/resume of the whole cluster
+state.
+
+Reference: nomadFSM.Snapshot/Restore with 21 typed record streams
+(nomad/fsm.go:36-59) + ``operator snapshot save/restore``
+(helper/snapshot). Here the snapshot is a versioned pickle of the store's
+tables (the record types are plain dataclasses); the format carries a
+magic + version header so future migrations can dispatch.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+
+SNAPSHOT_MAGIC = b"NOMADTPU-SNAP"
+SNAPSHOT_VERSION = 1
+
+
+def save_snapshot(store, path: str) -> int:
+    """Serialize a consistent snapshot; returns the index it captured."""
+    snap = store.snapshot()
+    payload = {
+        "version": SNAPSHOT_VERSION,
+        "index": snap.index,
+        "nodes": dict(snap._t.nodes),
+        "jobs": dict(snap._t.jobs),
+        "job_versions": dict(snap._t.job_versions),
+        "evals": dict(snap._t.evals),
+        "allocs": dict(snap._t.allocs),
+        "deployments": dict(snap._t.deployments),
+        "scheduler_config": snap._t.scheduler_config,
+    }
+    with open(path, "wb") as f:
+        f.write(SNAPSHOT_MAGIC)
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    return snap.index
+
+
+def restore_snapshot(path: str):
+    """Rebuild a StateStore from a snapshot file (indexes re-derived)."""
+    from .store import StateStore
+
+    with open(path, "rb") as f:
+        magic = f.read(len(SNAPSHOT_MAGIC))
+        if magic != SNAPSHOT_MAGIC:
+            raise ValueError(f"{path} is not a nomad-tpu snapshot")
+        payload = pickle.load(f)
+    if payload["version"] != SNAPSHOT_VERSION:
+        raise ValueError(f"unsupported snapshot version {payload['version']}")
+
+    store = StateStore()
+    index = max(payload["index"], 1)
+    for node in payload["nodes"].values():
+        store.upsert_node(index, node)
+    # jobs: preserve versions (upsert_job would re-version)
+    with store._lock:
+        jobs = store._own("jobs")
+        jobs.update(payload["jobs"])
+        versions = store._own("job_versions")
+        versions.update(payload["job_versions"])
+        store._bump(index, "jobs", "job_versions")
+    store.upsert_evals(index, list(payload["evals"].values()))
+    store.upsert_allocs(index, list(payload["allocs"].values()))
+    for d in payload["deployments"].values():
+        store.upsert_deployment(index, d)
+    store.set_scheduler_config(index, payload["scheduler_config"])
+    store._latest_index = max(store._latest_index, payload["index"])
+    return store

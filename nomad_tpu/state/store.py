@@ -140,6 +140,9 @@ class StateSnapshot:
                 return j
         return None
 
+    def job_versions_list(self, namespace: str, job_id: str) -> list[Job]:
+        return list(self._t.job_versions.get((namespace, job_id), ()))
+
     # -- evals ------------------------------------------------------------
     def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
         return self._t.evals.get(eval_id)
@@ -177,6 +180,9 @@ class StateSnapshot:
     # -- deployments ------------------------------------------------------
     def deployment_by_id(self, deployment_id: str):
         return self._t.deployments.get(deployment_id)
+
+    def deployments(self):
+        return self._t.deployments.values()
 
     def latest_deployment_by_job(self, namespace: str, job_id: str):
         ids = self._t.deployments_by_job.get((namespace, job_id), frozenset())
@@ -370,6 +376,22 @@ class StateStore(StateSnapshot):
             self._own("job_versions").pop((namespace, job_id), None)
             self._bump(index, "jobs", "job_versions")
 
+    def mark_job_stable(self, index: int, job: Job) -> None:
+        """Record a job version as a known-good rollback target
+        (UpdateJobStability in the reference)."""
+        with self._lock:
+            jobs = self._own("jobs")
+            key = job.namespaced_id()
+            if jobs.get(key) is not None and jobs[key].version == job.version:
+                jobs[key] = job
+            versions = self._own("job_versions")
+            hist = tuple(
+                job if j.version == job.version else j
+                for j in versions.get(key, ())
+            )
+            versions[key] = hist
+            self._bump(index, "jobs", "job_versions")
+
     def update_job_status(self, index: int, namespace: str, job_id: str, status: str):
         with self._lock:
             jobs = self._own("jobs")
@@ -459,6 +481,31 @@ class StateStore(StateSnapshot):
                 self._idx_add(by_node, a.node_id, a.id)
             self._idx_add(by_job, (a.namespace, a.job_id), a.id)
 
+    def delete_allocs(self, index: int, alloc_ids: Iterable[str]) -> None:
+        with self._lock:
+            table = self._own("allocs")
+            by_node = self._own("allocs_by_node")
+            by_job = self._own("allocs_by_job")
+            for aid in alloc_ids:
+                a = table.pop(aid, None)
+                if a is not None:
+                    if a.node_id:
+                        self._idx_del(by_node, a.node_id, aid)
+                    self._idx_del(by_job, (a.namespace, a.job_id), aid)
+            self._bump(index, "allocs")
+
+    def delete_deployment(self, index: int, deployment_id: str) -> None:
+        with self._lock:
+            table = self._own("deployments")
+            d = table.pop(deployment_id, None)
+            if d is not None:
+                self._idx_del(
+                    self._own("deployments_by_job"),
+                    (d.namespace, d.job_id),
+                    deployment_id,
+                )
+            self._bump(index, "deployments")
+
     def update_allocs_from_client(self, index: int, updates: Iterable[Allocation]):
         """Client status sync (Node.UpdateAlloc): merge client-owned fields
         onto the server copy."""
@@ -506,6 +553,13 @@ class StateStore(StateSnapshot):
             for allocs in result.node_allocation.values():
                 updates.extend(allocs)
             self._upsert_allocs_locked(index, updates)
+            for du in result.deployment_updates:
+                self._update_deployment_status_locked(
+                    index,
+                    du["deployment_id"],
+                    du["status"],
+                    du.get("description", ""),
+                )
             if result.deployment is not None:
                 table = self._own("deployments")
                 d = result.deployment
@@ -519,6 +573,63 @@ class StateStore(StateSnapshot):
                     d.id,
                 )
             self._bump(index, "allocs", "deployments")
+
+    def _update_deployment_status_locked(
+        self, index: int, deployment_id: str, status: str, desc: str
+    ) -> None:
+        import copy as _copy
+
+        table = self._own("deployments")
+        d = table.get(deployment_id)
+        if d is None:
+            return
+        d2 = _copy.deepcopy(d)
+        d2.status = status
+        d2.status_description = desc
+        d2.modify_index = index
+        table[deployment_id] = d2
+
+    def update_deployment_status(
+        self, index: int, deployment_id: str, status: str, desc: str = ""
+    ) -> None:
+        with self._lock:
+            self._update_deployment_status_locked(index, deployment_id, status, desc)
+            self._bump(index, "deployments")
+
+    def update_deployment(self, index: int, deployment) -> None:
+        """Replace a deployment record (watcher count refresh)."""
+        with self._lock:
+            table = self._own("deployments")
+            deployment.modify_index = index
+            table[deployment.id] = deployment
+            self._bump(index, "deployments")
+
+    def update_alloc_health(
+        self, index: int, healthy_ids: list[str], unhealthy_ids: list[str]
+    ) -> None:
+        """Set AllocDeploymentStatus health verdicts
+        (UpsertDeploymentAllocHealth in the reference)."""
+        import copy as _copy
+        import time as _t
+
+        from ..structs.deployment import AllocDeploymentStatus
+
+        with self._lock:
+            table = self._own("allocs")
+            for ids, verdict in ((healthy_ids, True), (unhealthy_ids, False)):
+                for aid in ids:
+                    a = table.get(aid)
+                    if a is None:
+                        continue
+                    a2 = _copy.copy(a)
+                    a2.deployment_status = AllocDeploymentStatus(
+                        healthy=verdict,
+                        timestamp_unix=_t.time(),
+                        canary=a.canary,
+                    )
+                    a2.modify_index = index
+                    table[aid] = a2
+            self._bump(index, "allocs")
 
     # -- scheduler config --------------------------------------------------
     def set_scheduler_config(self, index: int, cfg: SchedulerConfiguration) -> None:

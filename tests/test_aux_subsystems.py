@@ -1,0 +1,268 @@
+"""Auxiliary subsystem tests: cron, periodic dispatch, parameterized
+dispatch, core GC, event broker/stream, snapshot save/restore.
+(SURVEY.md §5 coverage.)"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.state.snapshot import restore_snapshot, save_snapshot
+from nomad_tpu.structs import PeriodicConfig
+from nomad_tpu.structs.job import ParameterizedJobConfig
+from nomad_tpu.utils.cron import Cron, CronParseError
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestCron:
+    def test_every_minute(self):
+        c = Cron("* * * * *")
+        base = 1700000000.0
+        nxt = c.next_after(base)
+        assert 0 < nxt - base <= 60
+        assert nxt % 60 == 0
+
+    def test_specific_time(self):
+        c = Cron("30 4 * * *")
+        import datetime
+
+        nxt = datetime.datetime.fromtimestamp(
+            c.next_after(1700000000.0), tz=datetime.timezone.utc
+        )
+        assert (nxt.hour, nxt.minute) == (4, 30)
+
+    def test_step_and_range(self):
+        c = Cron("*/15 9-17 * * 1-5")
+        assert c.minute == frozenset({0, 15, 30, 45})
+        assert 9 in c.hour and 17 in c.hour and 8 not in c.hour
+
+    def test_invalid(self):
+        for bad in ("* * *", "61 * * * *", "a * * * *", "*/0 * * * *"):
+            with pytest.raises(CronParseError):
+                Cron(bad)
+
+
+class TestPeriodicDispatch:
+    def test_tracked_and_launch(self):
+        s = Server(ServerConfig(num_workers=0))
+        s.establish_leadership()
+        try:
+            job = mock.batch_job()
+            job.periodic = PeriodicConfig(spec="* * * * *")
+            s.register_job(job)
+            assert s.periodic.tracked_count() == 1
+            child = s.periodic.force_launch(job)
+            assert child is not None
+            assert child.id.startswith(job.id + "/periodic-")
+            assert child.parent_id == job.id
+            assert not child.is_periodic()
+            assert s.store.job_by_id(child.namespace, child.id) is not None
+            # parent itself got no eval (periodic jobs don't run directly)
+            parent_evals = s.store.evals_by_job(job.namespace, job.id)
+            assert parent_evals == []
+        finally:
+            s.shutdown()
+
+    def test_prohibit_overlap(self):
+        s = Server(ServerConfig(num_workers=0))
+        s.establish_leadership()
+        try:
+            job = mock.batch_job()
+            job.periodic = PeriodicConfig(spec="* * * * *", prohibit_overlap=True)
+            s.register_job(job)
+            child = s.periodic.force_launch(job)
+            # pretend the child is still running
+            n = mock.node()
+            s.register_node(n)
+            a = mock.alloc(child, n)
+            s.store.upsert_allocs(s.store.latest_index + 1, [a])
+            assert s.periodic.force_launch(job) is None
+        finally:
+            s.shutdown()
+
+
+class TestParameterizedDispatch:
+    def test_dispatch_child(self):
+        s = Server(ServerConfig(num_workers=0))
+        s.establish_leadership()
+        try:
+            job = mock.batch_job()
+            job.parameterized = ParameterizedJobConfig(
+                payload="optional", meta_required=["who"]
+            )
+            s.register_job(job)
+            with pytest.raises(ValueError):
+                s.dispatch_job(job.namespace, job.id)  # missing meta
+            child, ev = s.dispatch_job(
+                job.namespace, job.id, payload=b"data", meta={"who": "me"}
+            )
+            assert child.parent_id == job.id
+            assert child.meta["who"] == "me"
+            assert child.payload == b"data"
+            with pytest.raises(ValueError):
+                s.dispatch_job(job.namespace, job.id, meta={"who": "x", "bad": "y"})
+        finally:
+            s.shutdown()
+
+
+class TestCoreGC:
+    def test_eval_and_job_gc(self):
+        from nomad_tpu.server.core_gc import CoreScheduler, GCConfig
+
+        s = Server(ServerConfig(num_workers=0))
+        gc = CoreScheduler(
+            s,
+            GCConfig(
+                eval_gc_threshold_s=0.0,
+                job_gc_threshold_s=0.0,
+                node_gc_threshold_s=0.0,
+                deployment_gc_threshold_s=0.0,
+            ),
+        )
+        job = mock.batch_job()
+        job.stop = True
+        job.status = "dead"
+        s.store.upsert_job(1, job)
+        ev = mock.eval_for(job, status="complete")
+        s.store.upsert_evals(2, [ev])
+        a = mock.alloc(job, client_status="complete", eval_id=ev.id)
+        s.store.upsert_allocs(3, [a])
+        node = mock.node(status="down")
+        s.store.upsert_node(4, node)
+
+        stats = gc.gc_all(now=time.time() + 10)
+        assert stats["evals"] == 1
+        assert stats["jobs"] == 1
+        assert stats["nodes"] == 1
+        assert s.store.eval_by_id(ev.id) is None
+        assert s.store.alloc_by_id(a.id) is None
+        assert s.store.job_by_id(job.namespace, job.id) is None
+        assert s.store.node_by_id(node.id) is None
+
+    def test_live_work_not_reaped(self):
+        from nomad_tpu.server.core_gc import CoreScheduler, GCConfig
+
+        s = Server(ServerConfig(num_workers=0))
+        gc = CoreScheduler(s, GCConfig(eval_gc_threshold_s=0.0))
+        job = mock.job()
+        s.store.upsert_job(1, job)
+        ev = mock.eval_for(job, status="complete")
+        s.store.upsert_evals(2, [ev])
+        live = mock.alloc(job, eval_id=ev.id)  # running
+        s.store.upsert_allocs(3, [live])
+        stats = gc.gc_all(now=time.time() + 10)
+        assert stats["evals"] == 0
+        assert s.store.eval_by_id(ev.id) is not None
+
+
+class TestEventBroker:
+    def test_publish_subscribe_filter(self):
+        from nomad_tpu.broker.event_broker import Event, EventBroker
+
+        b = EventBroker()
+        sub_all = b.subscribe()
+        sub_jobs = b.subscribe({"Job": ["*"]})
+        sub_key = b.subscribe({"Node": ["n1"]})
+        b.publish(
+            [
+                Event(topic="Job", type="JobRegistered", key="j1"),
+                Event(topic="Node", type="NodeRegistration", key="n1"),
+                Event(topic="Node", type="NodeRegistration", key="n2"),
+            ],
+            index=5,
+        )
+        assert len(sub_all.next_events(timeout=0.1)) == 3
+        jobs = sub_jobs.next_events(timeout=0.1)
+        assert [e.key for e in jobs] == ["j1"]
+        keyed = sub_key.next_events(timeout=0.1)
+        assert [e.key for e in keyed] == ["n1"]
+
+    def test_server_publishes_lifecycle_events(self):
+        s = Server(ServerConfig(num_workers=0))
+        s.establish_leadership()
+        try:
+            sub = s.events.subscribe({"Job": ["*"], "Node": ["*"]})
+            s.register_node(mock.node())
+            job = mock.job()
+            s.register_job(job)
+            evs = sub.next_events(timeout=1.0)
+            types = {e.type for e in evs}
+            assert "NodeRegistration" in types
+            assert "JobRegistered" in types
+        finally:
+            s.shutdown()
+
+
+class TestSnapshotPersistence:
+    def test_save_restore_roundtrip(self, tmp_path):
+        s = Server(ServerConfig(num_workers=0))
+        nodes = [mock.node() for _ in range(3)]
+        for i, n in enumerate(nodes):
+            s.store.upsert_node(i + 1, n)
+        job = mock.job()
+        s.store.upsert_job(10, job)
+        allocs = [mock.alloc(job, nodes[0]) for _ in range(2)]
+        s.store.upsert_allocs(11, allocs)
+        ev = mock.eval_for(job)
+        s.store.upsert_evals(12, [ev])
+
+        path = str(tmp_path / "state.snap")
+        index = save_snapshot(s.store, path)
+        assert index == 12
+
+        restored = restore_snapshot(path)
+        assert len(list(restored.nodes())) == 3
+        got_job = restored.job_by_id(job.namespace, job.id)
+        assert got_job is not None and got_job.version == job.version
+        assert len(restored.allocs_by_job(job.namespace, job.id)) == 2
+        assert restored.eval_by_id(ev.id) is not None
+        assert restored.latest_index >= 12
+
+    def test_server_boot_from_snapshot(self, tmp_path):
+        s = Server(ServerConfig(num_workers=1))
+        s.establish_leadership()
+        for _ in range(2):
+            s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 3
+        s.register_job(job)
+        assert s.wait_for_evals(15)
+        path = str(tmp_path / "state.snap")
+        save_snapshot(s.store, path)
+        s.shutdown()
+
+        s2 = Server.from_snapshot(path, ServerConfig(num_workers=1))
+        s2.establish_leadership()
+        try:
+            live = [
+                a
+                for a in s2.store.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()
+            ]
+            assert len(live) == 3
+            # the restored cluster still schedules: scale up
+            import copy
+
+            j2 = copy.deepcopy(s2.store.job_by_id(job.namespace, job.id))
+            j2.task_groups[0].count = 5
+            s2.register_job(j2)
+            assert s2.wait_for_evals(15)
+            live = [
+                a
+                for a in s2.store.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()
+            ]
+            assert len(live) == 5
+        finally:
+            s2.shutdown()

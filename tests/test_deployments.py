@@ -1,0 +1,192 @@
+"""Deployment tests — rolling updates, canaries, auto-promote/revert,
+progress deadlines. Mirrors nomad/deploymentwatcher tests + the
+deployment-aware reconciler coverage in reconcile_test.go."""
+
+import copy
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.structs.job import UpdateStrategy
+
+
+def wait_until(cond, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    a = DevAgent(data_dir=str(tmp_path), num_workers=1)
+    a.server.config.deployment_watch_interval = 0.05
+    a.server.deployment_watcher.interval = 0.05
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def service_job(count=4, **update_kw):
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].tasks[0].driver = "mock_driver"
+    job.task_groups[0].tasks[0].config = {"run_for": 600}
+    # tiny asks: the dev-agent node is the fingerprinted host, which can be
+    # small (1 core) — rollouts must fit old+new transients
+    job.task_groups[0].tasks[0].resources.cpu = 100
+    job.task_groups[0].tasks[0].resources.memory_mb = 64
+    defaults = dict(max_parallel=1, min_healthy_time_s=0.1, canary=0)
+    defaults.update(update_kw)
+    job.task_groups[0].update = UpdateStrategy(**defaults)
+    return job
+
+
+def live(agent, job):
+    return [
+        a
+        for a in agent.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+
+
+def active_deployment(agent, job):
+    return agent.store.latest_deployment_by_job(job.namespace, job.id)
+
+
+class TestRollingUpdate:
+    def test_rolling_respects_max_parallel(self, agent):
+        job = service_job(count=4, max_parallel=1)
+        agent.register_job(job)
+        assert wait_until(lambda: len(live(agent, job)) == 4)
+        assert wait_until(
+            lambda: all(a.client_status == "running" for a in live(agent, job))
+        )
+        # destructive update
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].resources.cpu = 110
+        agent.register_job(j2)
+
+        # rollout must complete, one at a time, driven by the watcher
+        assert wait_until(
+            lambda: len(
+                [a for a in live(agent, j2) if a.job_version == j2.version]
+            )
+            == 4,
+            timeout=30,
+        ), "rolling update should converge to the new version"
+        assert wait_until(
+            lambda: active_deployment(agent, j2).status == "successful",
+            timeout=15,
+        )
+        d = active_deployment(agent, j2)
+        assert d.task_groups["web"].healthy_allocs >= 4
+        # the rollout was genuinely incremental: old-version allocs were
+        # stopped over multiple plans, not all at once
+        stops = [
+            a
+            for a in agent.store.allocs_by_job(job.namespace, job.id)
+            if a.desired_status == "stop" and a.job_version == job.version
+        ]
+        assert len(stops) == 4
+
+    def test_deployment_tracks_health(self, agent):
+        job = service_job(count=2)
+        agent.register_job(job)
+        assert wait_until(lambda: len(live(agent, job)) == 2)
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].resources.cpu = 120
+        agent.register_job(j2)
+        assert wait_until(
+            lambda: (d := active_deployment(agent, j2)) is not None
+            and d.status == "successful",
+            timeout=30,
+        )
+        allocs = [a for a in live(agent, j2) if a.job_version == j2.version]
+        assert all(
+            a.deployment_status is not None and a.deployment_status.is_healthy()
+            for a in allocs
+        )
+
+
+class TestCanary:
+    def test_canary_gates_rollout_until_promote(self, agent):
+        job = service_job(count=3, canary=1, auto_promote=False)
+        agent.register_job(job)
+        assert wait_until(lambda: len(live(agent, job)) == 3)
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].resources.cpu = 130
+        agent.register_job(j2)
+
+        # one canary placed; old version untouched
+        assert wait_until(
+            lambda: len([a for a in live(agent, j2) if a.canary]) == 1,
+            timeout=20,
+        )
+        old_live = [a for a in live(agent, j2) if a.job_version == job.version]
+        assert len(old_live) == 3  # all old allocs still running
+        d = active_deployment(agent, j2)
+        assert d.requires_promotion()
+
+        # promote → rollout proceeds to completion
+        assert agent.server.deployment_watcher.promote(d.id)
+        assert wait_until(
+            lambda: len(
+                [a for a in live(agent, j2) if a.job_version == j2.version]
+            )
+            == 3,
+            timeout=30,
+        )
+
+    def test_auto_promote(self, agent):
+        job = service_job(count=2, canary=1, auto_promote=True)
+        agent.register_job(job)
+        assert wait_until(lambda: len(live(agent, job)) == 2)
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].resources.cpu = 130
+        agent.register_job(j2)
+        assert wait_until(
+            lambda: (d := active_deployment(agent, j2)) is not None
+            and d.status == "successful",
+            timeout=30,
+        ), "auto-promote should carry the rollout to success"
+
+
+class TestAutoRevert:
+    def test_failed_deployment_reverts(self, agent):
+        job = service_job(count=2, auto_revert=True)
+        agent.register_job(job)
+        assert wait_until(lambda: len(live(agent, job)) == 2)
+        assert wait_until(
+            lambda: all(a.client_status == "running" for a in live(agent, job))
+        )
+        v0 = job.version if hasattr(job, "version") else 0
+
+        # broken new version: tasks exit 1 immediately
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].config = {"run_for": 0.01, "exit_code": 1}
+        j2.task_groups[0].restart_policy.attempts = 0
+        j2.task_groups[0].restart_policy.mode = "fail"
+        agent.register_job(j2)
+
+        def reverted():
+            cur = agent.store.job_by_id(job.namespace, job.id)
+            return (
+                cur.version > j2.version
+                and cur.task_groups[0].tasks[0].config.get("run_for") == 600
+            )
+
+        assert wait_until(reverted, timeout=30), (
+            "auto-revert should re-register the previous good version"
+        )
+        # failed deployment recorded
+        failed = [
+            d
+            for d in agent.store.deployments()
+            if d.job_id == job.id and d.status == "failed"
+        ]
+        assert failed
