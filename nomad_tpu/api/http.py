@@ -128,6 +128,19 @@ class HTTPAgent:
             (re.compile(r"^/v1/agent/self$"), self.handle_agent_self),
             (re.compile(r"^/v1/status/leader$"), self.handle_leader),
             (re.compile(r"^/v1/metrics$"), self.handle_metrics),
+            (re.compile(r"^/v1/acl/bootstrap$"), self.handle_acl_bootstrap),
+            (re.compile(r"^/v1/acl/policies$"), self.handle_acl_policies),
+            (
+                re.compile(r"^/v1/acl/policy/(?P<name>[^/]+)$"),
+                self.handle_acl_policy,
+            ),
+            (re.compile(r"^/v1/acl/tokens$"), self.handle_acl_tokens),
+            (re.compile(r"^/v1/acl/token$"), self.handle_acl_token_create),
+            (re.compile(r"^/v1/acl/token/self$"), self.handle_acl_token_self),
+            (
+                re.compile(r"^/v1/acl/token/(?P<accessor>[^/]+)$"),
+                self.handle_acl_token,
+            ),
         ]
 
     # -- lifecycle ---------------------------------------------------------
@@ -145,6 +158,11 @@ class HTTPAgent:
                 query = {
                     k: v[0] for k, v in parse_qs(parsed.query).items()
                 }
+                # token: X-Nomad-Token header wins over ?token= (http.go
+                # parseToken); stashed under a reserved key for handlers
+                query["_secret"] = self.headers.get(
+                    "X-Nomad-Token", query.get("token", "")
+                )
                 body = None
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
@@ -240,9 +258,57 @@ class HTTPAgent:
             wait = float(query.get("wait", 5.0) or 5.0)
             self.server.store.wait_for_index(index + 1, timeout=wait)
 
+    # -- ACL enforcement ---------------------------------------------------
+    def _acl(self, query):
+        """Resolve the request token to a compiled ACL; None when ACLs are
+        disabled (reference: agent http.go parseToken + srv.ResolveToken)."""
+        from ..server.acl import TokenError
+
+        try:
+            return self.server.acl.resolve_token(query.get("_secret", ""))
+        except TokenError as e:
+            raise APIError(403, str(e)) from None
+
+    def _enforce_ns(self, query, cap: str) -> None:
+        acl = self._acl(query)
+        ns = query.get("namespace", "default")
+        if acl is not None and not acl.allow_namespace_operation(ns, cap):
+            raise APIError(403, "Permission denied")
+
+    def _enforce(self, query, check: str) -> None:
+        """check: '<scope>_<read|write|list>' e.g. 'node_write'."""
+        acl = self._acl(query)
+        if acl is None:
+            return
+        if not getattr(acl, f"allow_{check}")():
+            raise APIError(403, "Permission denied")
+
+    def _enforce_management(self, query) -> None:
+        acl = self._acl(query)
+        if acl is not None and not acl.is_management():
+            raise APIError(403, "Permission denied")
+
+    def _enforce_obj_ns(self, query, namespace: str, cap: str) -> None:
+        """Enforce against an object's OWN namespace (not the query param)
+        — the reference resolves the object first, then checks its
+        namespace (e.g. deployment_endpoint.go)."""
+        acl = self._acl(query)
+        if acl is not None and not acl.allow_namespace_operation(namespace, cap):
+            raise APIError(403, "Permission denied")
+
+    def _ns_filter(self, query, cap: str):
+        """Returns a predicate filtering objects to namespaces the token
+        can see (list endpoints must not leak other namespaces)."""
+        acl = self._acl(query)
+        if acl is None:
+            return lambda ns: True
+        return lambda ns: acl.allow_namespace_operation(ns, cap)
+
     # -- handlers ----------------------------------------------------------
     def handle_jobs(self, method, body, query):
         if method == "GET":
+            self._enforce_ns(query, "list-jobs")
+            visible = self._ns_filter(query, "list-jobs")
             self._maybe_block(query)
             return [
                 {
@@ -257,12 +323,14 @@ class HTTPAgent:
                     "modify_index": j.modify_index,
                 }
                 for j in self.server.store.jobs()
+                if visible(j.namespace)
             ]
         if method in ("POST", "PUT"):
             payload = body.get("job") if isinstance(body, dict) else None
             if payload is None:
                 raise APIError(400, "missing 'job' in body")
             job = decode_job(payload)
+            self._enforce_obj_ns(query, job.namespace or "default", "submit-job")
             if not job.id:
                 raise APIError(400, "job id is required")
             if not job.task_groups:
@@ -281,9 +349,11 @@ class HTTPAgent:
 
     def handle_job(self, method, body, query, job_id):
         if method == "GET":
+            self._enforce_ns(query, "read-job")
             self._maybe_block(query)
             return encode(self._get_job(job_id, query))
         if method == "DELETE":
+            self._enforce_ns(query, "submit-job")
             job = self._get_job(job_id, query)
             ev = self.server.deregister_job(job.namespace, job.id)
             return {"eval_id": ev.id if ev else ""}
@@ -294,6 +364,7 @@ class HTTPAgent:
         submitting the plan (SURVEY.md §3.3, nomad/job_endpoint Job.Plan)."""
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
+        self._enforce_ns(query, "submit-job")
         payload = body.get("job") if isinstance(body, dict) else None
         if payload is None:
             raise APIError(400, "missing 'job' in body")
@@ -303,10 +374,12 @@ class HTTPAgent:
         return plan_job(self.server.store, job)
 
     def handle_job_evals(self, method, body, query, job_id):
+        self._enforce_ns(query, "read-job")
         job = self._get_job(job_id, query)
         return [encode(e) for e in self.server.store.evals_by_job(job.namespace, job.id)]
 
     def handle_job_allocs(self, method, body, query, job_id):
+        self._enforce_ns(query, "read-job")
         job = self._get_job(job_id, query)
         self._maybe_block(query)
         return [
@@ -315,6 +388,7 @@ class HTTPAgent:
         ]
 
     def handle_job_summary(self, method, body, query, job_id):
+        self._enforce_ns(query, "read-job")
         job = self._get_job(job_id, query)
         allocs = self.server.store.allocs_by_job(job.namespace, job.id)
         summary: dict[str, dict[str, int]] = {}
@@ -341,6 +415,7 @@ class HTTPAgent:
         return {"job_id": job.id, "summary": summary}
 
     def handle_job_deployments(self, method, body, query, job_id):
+        self._enforce_ns(query, "read-job")
         job = self._get_job(job_id, query)
         return [
             encode(d)
@@ -349,8 +424,14 @@ class HTTPAgent:
         ]
 
     def handle_deployments(self, method, body, query):
+        self._enforce_ns(query, "read-job")
+        visible = self._ns_filter(query, "read-job")
         self._maybe_block(query)
-        return [encode(d) for d in self.server.store.deployments()]
+        return [
+            encode(d)
+            for d in self.server.store.deployments()
+            if visible(d.namespace)
+        ]
 
     def _get_deployment(self, deployment_id):
         d = self.server.store.deployment_by_id(deployment_id)
@@ -366,12 +447,15 @@ class HTTPAgent:
         return d
 
     def handle_deployment(self, method, body, query, deployment_id):
-        return encode(self._get_deployment(deployment_id))
+        d = self._get_deployment(deployment_id)
+        self._enforce_obj_ns(query, d.namespace, "read-job")
+        return encode(d)
 
     def handle_deployment_promote(self, method, body, query, deployment_id):
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
         d = self._get_deployment(deployment_id)
+        self._enforce_obj_ns(query, d.namespace, "submit-job")
         ok = self.server.deployment_watcher.promote(d.id)
         if not ok:
             raise APIError(400, "deployment is not active")
@@ -381,12 +465,14 @@ class HTTPAgent:
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
         d = self._get_deployment(deployment_id)
+        self._enforce_obj_ns(query, d.namespace, "submit-job")
         ok = self.server.deployment_watcher.fail(d.id)
         if not ok:
             raise APIError(400, "deployment is not active")
         return {"failed": True}
 
     def handle_nodes(self, method, body, query):
+        self._enforce(query, "node_read")
         self._maybe_block(query)
         return [
             {
@@ -415,9 +501,11 @@ class HTTPAgent:
         return node
 
     def handle_node(self, method, body, query, node_id):
+        self._enforce(query, "node_read")
         return encode(self._get_node(node_id))
 
     def handle_node_drain(self, method, body, query, node_id):
+        self._enforce(query, "node_write")
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
         node = self._get_node(node_id)
@@ -433,6 +521,7 @@ class HTTPAgent:
         return {"eval_ids": [e.id for e in evals]}
 
     def handle_node_eligibility(self, method, body, query, node_id):
+        self._enforce(query, "node_write")
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
         node = self._get_node(node_id)
@@ -447,10 +536,13 @@ class HTTPAgent:
         return {"eligibility": elig}
 
     def handle_node_allocs(self, method, body, query, node_id):
+        self._enforce(query, "node_read")
         node = self._get_node(node_id)
         return [encode(a) for a in self.server.store.allocs_by_node(node.id)]
 
     def handle_allocs(self, method, body, query):
+        self._enforce_ns(query, "read-job")
+        visible = self._ns_filter(query, "read-job")
         self._maybe_block(query)
         return [
             {
@@ -465,6 +557,7 @@ class HTTPAgent:
                 "modify_index": a.modify_index,
             }
             for a in self.server.store.allocs()
+            if visible(a.namespace)
         ]
 
     def handle_alloc(self, method, body, query, alloc_id):
@@ -476,21 +569,28 @@ class HTTPAgent:
             if len(matches) != 1:
                 raise APIError(404, f"alloc {alloc_id} not found")
             a = matches[0]
+        self._enforce_obj_ns(query, a.namespace, "read-job")
         return encode(a)
 
     def handle_evals(self, method, body, query):
+        self._enforce_ns(query, "read-job")
+        visible = self._ns_filter(query, "read-job")
         self._maybe_block(query)
-        return [encode(e) for e in self.server.store.evals()]
+        return [
+            encode(e) for e in self.server.store.evals() if visible(e.namespace)
+        ]
 
     def handle_eval(self, method, body, query, eval_id):
         e = self.server.store.eval_by_id(eval_id)
         if e is None:
             raise APIError(404, f"eval {eval_id} not found")
+        self._enforce_obj_ns(query, e.namespace, "read-job")
         return encode(e)
 
     def handle_scheduler_config(self, method, body, query):
         cfg = self.server.store.scheduler_config()
         if method == "GET":
+            self._enforce(query, "operator_read")
             return {
                 "scheduler_algorithm": cfg.scheduler_algorithm,
                 "preemption_config": {
@@ -502,6 +602,7 @@ class HTTPAgent:
                 "pause_eval_broker": cfg.pause_eval_broker,
             }
         if method in ("POST", "PUT"):
+            self._enforce(query, "operator_write")
             if not body:
                 raise APIError(400, "missing body")
             from ..state import SchedulerConfiguration
@@ -534,6 +635,7 @@ class HTTPAgent:
     def handle_job_dispatch(self, method, body, query, job_id):
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
+        self._enforce_ns(query, "dispatch-job")
         body = body or {}
         ns = query.get("namespace", "default")
         import base64
@@ -550,6 +652,7 @@ class HTTPAgent:
     def handle_periodic_force(self, method, body, query, job_id):
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
+        self._enforce_ns(query, "submit-job")
         job = self._get_job(job_id, query)
         if not job.is_periodic():
             raise APIError(400, f"job {job_id} is not periodic")
@@ -559,7 +662,21 @@ class HTTPAgent:
         return {"launched_job_id": child.id}
 
     def handle_event_stream(self, method, body, query):
-        """NDJSON event stream (http.go:359 /v1/event/stream)."""
+        """NDJSON event stream (http.go:359 /v1/event/stream). Events are
+        ACL-filtered per topic: Node events need node:read, namespaced
+        topics need read-job on the event's namespace (the reference's
+        aclFilter in nomad/stream/event_broker.go)."""
+        acl = self._acl(query)
+
+        def event_visible(ev) -> bool:
+            if acl is None or acl.is_management():
+                return True
+            if ev.topic == "Node":
+                return acl.allow_node_read()
+            return acl.allow_namespace_operation(
+                ev.namespace or "default", "read-job"
+            )
+
         from_index = int(query.get("index", 0) or 0)
         topics = None
         if "topic" in query:
@@ -580,6 +697,8 @@ class HTTPAgent:
             deadline = _t.time() + wait
             while _t.time() < deadline:
                 for ev in sub.next_events(timeout=0.5):
+                    if not event_visible(ev):
+                        continue
                     yield ev.to_json()
                     n += 1
                     if limit and n >= limit:
@@ -590,6 +709,7 @@ class HTTPAgent:
     def handle_snapshot_save(self, method, body, query):
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
+        self._enforce(query, "operator_write")
         path = (body or {}).get("path")
         if not path:
             raise APIError(400, "missing 'path'")
@@ -599,6 +719,7 @@ class HTTPAgent:
         return {"index": index, "path": path}
 
     def handle_agent_self(self, method, body, query):
+        self._enforce(query, "agent_read")
         out = {
             "member": {"name": "server-1", "status": "alive"},
             "stats": {
@@ -622,3 +743,105 @@ class HTTPAgent:
         from ..utils.metrics import global_metrics
 
         return global_metrics.snapshot()
+
+    # -- ACL endpoints (nomad/acl_endpoint.go) -----------------------------
+    def handle_acl_bootstrap(self, method, body, query):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        try:
+            token = self.server.acl.bootstrap()
+        except PermissionError as e:
+            raise APIError(400, str(e)) from None
+        return token.to_api()
+
+    def handle_acl_policies(self, method, body, query):
+        self._enforce_management(query)
+        self._maybe_block(query)
+        return [
+            {
+                "Name": p.name,
+                "Description": p.description,
+                "CreateIndex": p.create_index,
+                "ModifyIndex": p.modify_index,
+            }
+            for p in self.server.store.acl_policies()
+        ]
+
+    def handle_acl_policy(self, method, body, query, name):
+        from ..acl import ACLPolicyRecord, AclPolicyError
+
+        if method == "GET":
+            # a token may read the policies attached to itself
+            acl = self._acl(query)
+            if acl is not None and not acl.is_management():
+                token = self.server.store.acl_token_by_secret(
+                    query.get("_secret", "")
+                )
+                if token is None or name not in token.policies:
+                    raise APIError(403, "Permission denied")
+            p = self.server.store.acl_policy_by_name(name)
+            if p is None:
+                raise APIError(404, f"policy {name} not found")
+            return p.to_api()
+        if method in ("POST", "PUT"):
+            self._enforce_management(query)
+            body = body or {}
+            rec = ACLPolicyRecord(
+                name=name,
+                description=body.get("Description", body.get("description", "")),
+                rules=body.get("Rules", body.get("rules", "")),
+            )
+            try:
+                self.server.acl.upsert_policies([rec])
+            except (AclPolicyError, ValueError) as e:
+                raise APIError(400, str(e)) from None
+            return {"updated": True}
+        if method == "DELETE":
+            self._enforce_management(query)
+            self.server.acl.delete_policies([name])
+            return {"deleted": True}
+        raise APIError(405, f"method {method} not allowed")
+
+    def handle_acl_tokens(self, method, body, query):
+        self._enforce_management(query)
+        self._maybe_block(query)
+        return [t.to_api(redact_secret=True) for t in self.server.store.acl_tokens()]
+
+    def handle_acl_token_create(self, method, body, query):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        self._enforce_management(query)
+        from ..acl import ACLToken
+
+        body = body or {}
+        token = ACLToken(
+            name=body.get("Name", body.get("name", "")),
+            type=body.get("Type", body.get("type", "client")),
+            policies=body.get("Policies", body.get("policies", [])) or [],
+            global_=body.get("Global", body.get("global", False)),
+        )
+        try:
+            self.server.acl.upsert_tokens([token])
+        except ValueError as e:
+            raise APIError(400, str(e)) from None
+        return token.to_api()
+
+    def handle_acl_token_self(self, method, body, query):
+        secret = query.get("_secret", "")
+        token = self.server.store.acl_token_by_secret(secret)
+        if token is None:
+            raise APIError(403, "ACL token not found")
+        return token.to_api()
+
+    def handle_acl_token(self, method, body, query, accessor):
+        if method == "GET":
+            self._enforce_management(query)
+            t = self.server.store.acl_token_by_accessor(accessor)
+            if t is None:
+                raise APIError(404, f"token {accessor} not found")
+            return t.to_api()
+        if method == "DELETE":
+            self._enforce_management(query)
+            self.server.acl.delete_tokens([accessor])
+            return {"deleted": True}
+        raise APIError(405, f"method {method} not allowed")

@@ -52,11 +52,13 @@ class ServerConfig:
         region: str = "global",
         heartbeat_ttl: float = 5.0,
         deployment_watch_interval: float = 0.25,
+        acl_enabled: bool = False,
     ):
         self.num_workers = num_workers
         self.region = region
         self.heartbeat_ttl = heartbeat_ttl
         self.deployment_watch_interval = deployment_watch_interval
+        self.acl_enabled = acl_enabled
 
 
 class Server:
@@ -86,6 +88,9 @@ class Server:
         self.periodic = PeriodicDispatch(self)
         self.core_gc = CoreScheduler(self)
         self.events = StreamBroker()
+        from .acl import ACLService
+
+        self.acl = ACLService(self)
         # capacity changes unblock blocked evals (blocked_evals.go:55)
         self.store.add_listener(self._on_state_change)
 

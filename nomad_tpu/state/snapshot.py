@@ -29,6 +29,9 @@ def save_snapshot(store, path: str) -> int:
         "evals": dict(snap._t.evals),
         "allocs": dict(snap._t.allocs),
         "deployments": dict(snap._t.deployments),
+        "acl_policies": dict(snap._t.acl_policies),
+        "acl_tokens": dict(snap._t.acl_tokens),
+        "acl_bootstrap": snap._t.indexes.get("acl_bootstrap", 0),
         "scheduler_config": snap._t.scheduler_config,
     }
     with open(path, "wb") as f:
@@ -64,6 +67,13 @@ def restore_snapshot(path: str):
     store.upsert_allocs(index, list(payload["allocs"].values()))
     for d in payload["deployments"].values():
         store.upsert_deployment(index, d)
+    if payload.get("acl_policies"):
+        store.upsert_acl_policies(index, list(payload["acl_policies"].values()))
+    if payload.get("acl_tokens"):
+        store.upsert_acl_tokens(index, list(payload["acl_tokens"].values()))
+    if payload.get("acl_bootstrap"):
+        with store._lock:
+            store._own("indexes")["acl_bootstrap"] = payload["acl_bootstrap"]
     store.set_scheduler_config(index, payload["scheduler_config"])
     store._latest_index = max(store._latest_index, payload["index"])
     return store

@@ -74,6 +74,9 @@ class _Tables:
         "evals_by_job",
         "deployments",
         "deployments_by_job",
+        "acl_policies",
+        "acl_tokens",
+        "acl_tokens_by_secret",
         "indexes",
         "scheduler_config",
     )
@@ -89,6 +92,9 @@ class _Tables:
         self.evals_by_job: dict[tuple[str, str], frozenset[str]] = {}
         self.deployments: dict[str, object] = {}
         self.deployments_by_job: dict[tuple[str, str], frozenset[str]] = {}
+        self.acl_policies: dict[str, object] = {}
+        self.acl_tokens: dict[str, object] = {}  # accessor_id → ACLToken
+        self.acl_tokens_by_secret: dict[str, str] = {}  # secret → accessor
         self.indexes: dict[str, int] = {}
         self.scheduler_config: SchedulerConfiguration = SchedulerConfiguration()
 
@@ -103,6 +109,9 @@ class _Tables:
         "evals_by_job",
         "deployments",
         "deployments_by_job",
+        "acl_policies",
+        "acl_tokens",
+        "acl_tokens_by_secret",
         "indexes",
     )
 
@@ -192,6 +201,26 @@ class StateSnapshot:
             if d is not None and (best is None or d.create_index > best.create_index):
                 best = d
         return best
+
+    # -- ACL ---------------------------------------------------------------
+    def acl_policy_by_name(self, name: str):
+        return self._t.acl_policies.get(name)
+
+    def acl_policies(self) -> Iterable:
+        return self._t.acl_policies.values()
+
+    def acl_token_by_accessor(self, accessor_id: str):
+        return self._t.acl_tokens.get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        accessor = self._t.acl_tokens_by_secret.get(secret_id)
+        return self._t.acl_tokens.get(accessor) if accessor else None
+
+    def acl_tokens(self) -> Iterable:
+        return self._t.acl_tokens.values()
+
+    def acl_bootstrapped(self) -> bool:
+        return self._t.indexes.get("acl_bootstrap", 0) > 0
 
     # -- meta -------------------------------------------------------------
     def scheduler_config(self) -> SchedulerConfiguration:
@@ -630,6 +659,67 @@ class StateStore(StateSnapshot):
                     a2.modify_index = index
                     table[aid] = a2
             self._bump(index, "allocs")
+
+    # -- ACL ---------------------------------------------------------------
+    def upsert_acl_policies(self, index: int, policies: Iterable) -> None:
+        with self._lock:
+            table = self._own("acl_policies")
+            for p in policies:
+                existing = table.get(p.name)
+                p.create_index = existing.create_index if existing else index
+                p.modify_index = index
+                table[p.name] = p
+            self._bump(index, "acl_policies")
+
+    def delete_acl_policies(self, index: int, names: Iterable[str]) -> None:
+        with self._lock:
+            table = self._own("acl_policies")
+            for name in names:
+                table.pop(name, None)
+            self._bump(index, "acl_policies")
+
+    def upsert_acl_tokens(self, index: int, tokens: Iterable) -> None:
+        with self._lock:
+            table = self._own("acl_tokens")
+            by_secret = self._own("acl_tokens_by_secret")
+            for t in tokens:
+                existing = table.get(t.accessor_id)
+                if existing is not None:
+                    t.create_index = existing.create_index
+                    if existing.secret_id != t.secret_id:
+                        by_secret.pop(existing.secret_id, None)
+                else:
+                    t.create_index = index
+                t.modify_index = index
+                table[t.accessor_id] = t
+                by_secret[t.secret_id] = t.accessor_id
+            self._bump(index, "acl_tokens")
+
+    def delete_acl_tokens(self, index: int, accessor_ids: Iterable[str]) -> None:
+        with self._lock:
+            table = self._own("acl_tokens")
+            by_secret = self._own("acl_tokens_by_secret")
+            for aid in accessor_ids:
+                t = table.pop(aid, None)
+                if t is not None:
+                    by_secret.pop(t.secret_id, None)
+            self._bump(index, "acl_tokens")
+
+    def bootstrap_acl_token(self, index: int, token) -> None:
+        """One-shot bootstrap (acl_endpoint.go Bootstrap): guarded by the
+        acl_bootstrap index sentinel."""
+        with self._lock:
+            if self._t.indexes.get("acl_bootstrap", 0) > 0:
+                raise PermissionError("ACL bootstrap already done")
+            table = self._own("acl_tokens")
+            by_secret = self._own("acl_tokens_by_secret")
+            token.create_index = index
+            token.modify_index = index
+            table[token.accessor_id] = token
+            by_secret[token.secret_id] = token.accessor_id
+            idx = self._own("indexes")
+            idx["acl_bootstrap"] = index
+            self._bump(index, "acl_tokens")
 
     # -- scheduler config --------------------------------------------------
     def set_scheduler_config(self, index: int, cfg: SchedulerConfiguration) -> None:

@@ -1,0 +1,350 @@
+"""ACL engine tests.
+
+Mirrors acl/policy_test.go + acl/acl_test.go cases: policy parse with
+shorthand expansion, merge with deny precedence, glob matching with
+closest-match selection, and the token/bootstrap/endpoint flow
+(nomad/acl_endpoint.go).
+"""
+
+import pytest
+
+from nomad_tpu.acl import (
+    ACLPolicyRecord,
+    ACLToken,
+    AclPolicyError,
+    MANAGEMENT_ACL,
+    compile_acl,
+    parse_policy,
+)
+from nomad_tpu.acl.acl import max_privilege
+from nomad_tpu.server.acl import TokenError
+from nomad_tpu.server.server import Server, ServerConfig
+
+
+# -- policy parse -----------------------------------------------------------
+
+
+def test_parse_policy_shorthand_expansion():
+    p = parse_policy('namespace "default" { policy = "read" }')
+    ns = p.namespaces[0]
+    assert ns.name == "default"
+    assert "read-job" in ns.capabilities
+    assert "list-jobs" in ns.capabilities
+    assert "submit-job" not in ns.capabilities
+
+
+def test_parse_policy_write_and_capabilities_merge():
+    p = parse_policy(
+        """
+        namespace "dev" {
+          policy       = "write"
+          capabilities = ["alloc-node-exec"]
+        }
+        """
+    )
+    caps = p.namespaces[0].capabilities
+    assert "submit-job" in caps and "alloc-node-exec" in caps
+
+
+def test_parse_policy_coarse_blocks():
+    p = parse_policy(
+        """
+        agent    { policy = "read" }
+        node     { policy = "write" }
+        operator { policy = "deny" }
+        quota    { policy = "read" }
+        plugin   { policy = "list" }
+        """
+    )
+    assert p.agent == "read"
+    assert p.node == "write"
+    assert p.operator == "deny"
+    assert p.plugin == "list"
+
+
+def test_parse_policy_invalid():
+    with pytest.raises(AclPolicyError):
+        parse_policy('namespace "x" { policy = "bogus" }')
+    with pytest.raises(AclPolicyError):
+        parse_policy('namespace "bad name!" { policy = "read" }')
+    with pytest.raises(AclPolicyError):
+        parse_policy('namespace "x" { capabilities = ["not-a-cap"] }')
+    with pytest.raises(AclPolicyError):
+        parse_policy("agent { }")  # empty overall policy
+    with pytest.raises(AclPolicyError):
+        parse_policy('plugin { policy = "scale" }')
+
+
+def test_parse_host_volume_policy():
+    p = parse_policy('host_volume "prod-*" { policy = "write" }')
+    hv = p.host_volumes[0]
+    assert "mount-readwrite" in hv.capabilities
+
+
+# -- compiled ACL -----------------------------------------------------------
+
+
+def test_max_privilege_deny_wins():
+    assert max_privilege("deny", "write") == "deny"
+    assert max_privilege("read", "write") == "write"
+    assert max_privilege("", "list") == "list"
+
+
+def test_acl_namespace_check():
+    acl = compile_acl([parse_policy('namespace "default" { policy = "read" }')])
+    assert acl.allow_namespace_operation("default", "read-job")
+    assert not acl.allow_namespace_operation("default", "submit-job")
+    assert not acl.allow_namespace_operation("other", "read-job")
+
+
+def test_acl_merge_deny_precedence():
+    acl = compile_acl(
+        [
+            parse_policy('namespace "default" { policy = "write" }'),
+            parse_policy('namespace "default" { policy = "deny" }'),
+        ]
+    )
+    assert not acl.allow_namespace_operation("default", "read-job")
+
+
+def test_acl_glob_closest_match():
+    # acl/acl_test.go TestWildcardNamespaceMatching: smallest char difference
+    acl = compile_acl(
+        [
+            parse_policy('namespace "*" { policy = "deny" }'),
+            parse_policy('namespace "prod-*" { policy = "read" }'),
+        ]
+    )
+    # prod-api matches both; "prod-*" is closer (difference 2 vs 7)
+    assert acl.allow_namespace_operation("prod-api", "read-job")
+    assert not acl.allow_namespace_operation("dev", "read-job")
+    # exact beats glob
+    acl2 = compile_acl(
+        [
+            parse_policy('namespace "prod-*" { policy = "write" }'),
+            parse_policy('namespace "prod-api" { policy = "deny" }'),
+        ]
+    )
+    assert not acl2.allow_namespace_operation("prod-api", "submit-job")
+    assert acl2.allow_namespace_operation("prod-db", "submit-job")
+
+
+def test_acl_coarse_scopes():
+    acl = compile_acl(
+        [parse_policy('node { policy = "write" }\nagent { policy = "read" }')]
+    )
+    assert acl.allow_node_write() and acl.allow_node_read()
+    assert acl.allow_agent_read() and not acl.allow_agent_write()
+    assert not acl.allow_operator_read()
+
+
+def test_management_acl_allows_everything():
+    assert MANAGEMENT_ACL.allow_namespace_operation("any", "submit-job")
+    assert MANAGEMENT_ACL.allow_operator_write()
+    assert MANAGEMENT_ACL.is_management()
+
+
+def test_host_volume_check():
+    acl = compile_acl([parse_policy('host_volume "data-*" { policy = "read" }')])
+    assert acl.allow_host_volume_operation("data-1", "mount-readonly")
+    assert not acl.allow_host_volume_operation("data-1", "mount-readwrite")
+    assert not acl.allow_host_volume_operation("other", "mount-readonly")
+
+
+# -- server endpoints -------------------------------------------------------
+
+
+@pytest.fixture
+def acl_server():
+    s = Server(ServerConfig(num_workers=0, acl_enabled=True))
+    yield s
+    s.shutdown()
+
+
+def test_bootstrap_once(acl_server):
+    token = acl_server.acl.bootstrap()
+    assert token.is_management()
+    with pytest.raises(PermissionError):
+        acl_server.acl.bootstrap()
+
+
+def test_resolve_token_flow(acl_server):
+    boot = acl_server.acl.bootstrap()
+    assert acl_server.acl.resolve_token(boot.secret_id).is_management()
+
+    acl_server.acl.upsert_policies(
+        [
+            ACLPolicyRecord(
+                name="readonly",
+                rules='namespace "default" { policy = "read" }',
+            )
+        ]
+    )
+    (tok,) = acl_server.acl.upsert_tokens(
+        [ACLToken(name="ro", type="client", policies=["readonly"])]
+    )
+    acl = acl_server.acl.resolve_token(tok.secret_id)
+    assert acl.allow_namespace_operation("default", "read-job")
+    assert not acl.allow_namespace_operation("default", "submit-job")
+
+    with pytest.raises(TokenError):
+        acl_server.acl.resolve_token("no-such-secret")
+
+    # anonymous (empty) token: denied by default
+    anon = acl_server.acl.resolve_token("")
+    assert not anon.allow_namespace_operation("default", "read-job")
+
+    # anonymous policy grants
+    acl_server.acl.upsert_policies(
+        [
+            ACLPolicyRecord(
+                name="anonymous",
+                rules='namespace "default" { policy = "read" }',
+            )
+        ]
+    )
+    anon = acl_server.acl.resolve_token("")
+    assert anon.allow_namespace_operation("default", "read-job")
+
+
+def test_token_validation(acl_server):
+    with pytest.raises(ValueError):
+        acl_server.acl.upsert_tokens([ACLToken(type="client", policies=[])])
+    with pytest.raises(ValueError):
+        acl_server.acl.upsert_tokens(
+            [ACLToken(type="management", policies=["x"])]
+        )
+    with pytest.raises(ValueError):
+        acl_server.acl.upsert_tokens(
+            [ACLToken(type="client", policies=["missing"])]
+        )
+
+
+def test_acl_disabled_resolves_none():
+    s = Server(ServerConfig(num_workers=0, acl_enabled=False))
+    try:
+        assert s.acl.resolve_token("anything") is None
+        # bootstrap refused while ACLs are disabled (no pre-planted tokens)
+        with pytest.raises(PermissionError):
+            s.acl.bootstrap()
+    finally:
+        s.shutdown()
+
+
+def test_list_endpoints_filter_by_namespace_visibility():
+    """A token scoped to one namespace must not see other namespaces'
+    jobs/evals/allocs in list responses."""
+    import json
+    import urllib.request
+
+    from nomad_tpu import mock
+    from nomad_tpu.api.http import HTTPAgent
+
+    s = Server(ServerConfig(num_workers=0, acl_enabled=True))
+    agent = HTTPAgent(s, port=0)
+    agent.start()
+    try:
+        boot = s.acl.bootstrap()
+        s.acl.upsert_policies(
+            [
+                ACLPolicyRecord(
+                    name="default-only",
+                    rules='namespace "default" { policy = "read" }',
+                )
+            ]
+        )
+        (tok,) = s.acl.upsert_tokens(
+            [ACLToken(name="scoped", type="client", policies=["default-only"])]
+        )
+        j1 = mock.job()
+        j2 = mock.job()
+        j2.namespace = "secret"
+        s.register_job(j1)
+        s.register_job(j2)
+
+        def req(path, token):
+            r = urllib.request.Request(agent.address + path)
+            r.add_header("X-Nomad-Token", token)
+            with urllib.request.urlopen(r) as resp:
+                return json.loads(resp.read())
+
+        mgmt_jobs = req("/v1/jobs", boot.secret_id)
+        assert {j["namespace"] for j in mgmt_jobs} == {"default", "secret"}
+        scoped_jobs = req("/v1/jobs", tok.secret_id)
+        assert {j["namespace"] for j in scoped_jobs} == {"default"}
+        scoped_evals = req("/v1/evaluations", tok.secret_id)
+        assert all(e["namespace"] == "default" for e in scoped_evals)
+    finally:
+        agent.stop()
+        s.shutdown()
+
+
+# -- HTTP enforcement -------------------------------------------------------
+
+
+def test_http_acl_enforcement():
+    import json
+    import urllib.request
+
+    from nomad_tpu.api.http import HTTPAgent
+
+    s = Server(ServerConfig(num_workers=0, acl_enabled=True))
+    agent = HTTPAgent(s, port=0)
+    agent.start()
+    try:
+        boot = s.acl.bootstrap()
+
+        def req(path, method="GET", body=None, token=None, expect=200):
+            r = urllib.request.Request(
+                agent.address + path, method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+            )
+            if token:
+                r.add_header("X-Nomad-Token", token)
+            try:
+                with urllib.request.urlopen(r) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        # anonymous denied
+        status, _ = req("/v1/jobs")
+        assert status == 403
+        # management allowed
+        status, _ = req("/v1/jobs", token=boot.secret_id)
+        assert status == 200
+        # create a read-only token over HTTP
+        status, _ = req(
+            "/v1/acl/policy/readonly",
+            method="POST",
+            body={"Rules": 'namespace "default" { policy = "read" }'},
+            token=boot.secret_id,
+        )
+        assert status == 200
+        status, tok = req(
+            "/v1/acl/token",
+            method="POST",
+            body={"Name": "ro", "Type": "client", "Policies": ["readonly"]},
+            token=boot.secret_id,
+        )
+        assert status == 200
+        ro = tok["SecretID"]
+        status, _ = req("/v1/jobs", token=ro)
+        assert status == 200
+        # read-only cannot submit
+        status, _ = req(
+            "/v1/jobs",
+            method="POST",
+            body={"job": {"id": "x", "task_groups": [{"name": "g"}]}},
+            token=ro,
+        )
+        assert status == 403
+        # read-only cannot manage ACLs
+        status, _ = req("/v1/acl/tokens", token=ro)
+        assert status == 403
+        # token self works for any valid token
+        status, self_tok = req("/v1/acl/token/self", token=ro)
+        assert status == 200 and self_tok["Name"] == "ro"
+    finally:
+        agent.stop()
+        s.shutdown()

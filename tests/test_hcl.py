@@ -1,0 +1,135 @@
+"""HCL reader tests (nomad_tpu.utils.hcl).
+
+Mirrors the grammar surface the reference exercises through
+acl/policy_test.go and jobspec2 parse tests.
+"""
+
+import pytest
+
+from nomad_tpu.utils import hcl
+
+
+def test_attrs_and_types():
+    body = hcl.parse(
+        """
+        count   = 3
+        ratio   = 0.5
+        name    = "web"
+        enabled = true
+        nothing = null
+        tags    = ["a", "b"]
+        meta    = { k = "v", n = 2 }
+        """
+    )
+    v = hcl.body_to_value(body)
+    assert v == {
+        "count": 3,
+        "ratio": 0.5,
+        "name": "web",
+        "enabled": True,
+        "nothing": None,
+        "tags": ["a", "b"],
+        "meta": {"k": "v", "n": 2},
+    }
+
+
+def test_blocks_and_labels():
+    body = hcl.parse(
+        """
+        job "example" {
+          datacenters = ["dc1"]
+          group "web" {
+            count = 2
+            task "server" {
+              driver = "exec"
+            }
+          }
+        }
+        """
+    )
+    job = body.first("job")
+    assert job.labels == ["example"]
+    group = job.body.first("group")
+    assert group.labels == ["web"]
+    ctx = hcl.EvalContext()
+    assert group.body.attrs["count"].expr(ctx) == 2
+    assert group.body.first("task").labels == ["server"]
+
+
+def test_comments():
+    body = hcl.parse(
+        """
+        # comment
+        a = 1 // trailing
+        /* block
+           comment */
+        b = 2
+        """
+    )
+    v = hcl.body_to_value(body)
+    assert v == {"a": 1, "b": 2}
+
+
+def test_string_interpolation_and_escapes():
+    body = hcl.parse('x = "a-${var.region}-z"\ny = "q\\"esc\\""')
+    ctx = hcl.EvalContext({"var": {"region": "us"}})
+    assert body.attrs["x"].expr(ctx) == "a-us-z"
+    assert body.attrs["y"].expr(ctx) == 'q"esc"'
+
+
+def test_expressions():
+    ctx = hcl.EvalContext({"n": 4})
+    assert hcl.parse_expression("1 + 2 * 3")(ctx) == 7
+    assert hcl.parse_expression("(1 + 2) * 3")(ctx) == 9
+    assert hcl.parse_expression("n > 3 ? \"big\" : \"small\"")(ctx) == "big"
+    assert hcl.parse_expression("!false && true")(ctx) is True
+    assert hcl.parse_expression("-n")(ctx) == -4
+    assert hcl.parse_expression("n % 3")(ctx) == 1
+
+
+def test_traversal_and_index():
+    ctx = hcl.EvalContext({"var": {"xs": [10, 20], "m": {"k": "v"}}})
+    assert hcl.parse_expression("var.xs[1]")(ctx) == 20
+    assert hcl.parse_expression("var.m.k")(ctx) == "v"
+    assert hcl.parse_expression('var.m["k"]')(ctx) == "v"
+
+
+def test_functions():
+    ctx = hcl.EvalContext()
+    assert hcl.parse_expression('upper("ab")')(ctx) == "AB"
+    assert hcl.parse_expression('join(",", ["a", "b"])')(ctx) == "a,b"
+    assert hcl.parse_expression("length([1, 2, 3])")(ctx) == 3
+    assert hcl.parse_expression('format("%s-%d", "x", 3)')(ctx) == "x-3"
+    assert hcl.parse_expression("min(3, 1, 2)")(ctx) == 1
+    assert hcl.parse_expression('contains(["a"], "a")')(ctx) is True
+    assert hcl.parse_expression("merge({a = 1}, {b = 2})")(ctx) == {"a": 1, "b": 2}
+
+
+def test_heredoc():
+    body = hcl.parse('script = <<EOF\nline1\nline2\nEOF\n')
+    assert body.attrs["script"].expr(hcl.EvalContext()) == "line1\nline2"
+    body = hcl.parse('script = <<-EOF\n    indented\n    lines\n  EOF\n')
+    assert body.attrs["script"].expr(hcl.EvalContext()) == "indented\nlines"
+
+
+def test_multiline_lists():
+    body = hcl.parse(
+        """
+        xs = [
+          "a",
+          "b",
+        ]
+        """
+    )
+    assert body.attrs["xs"].expr(hcl.EvalContext()) == ["a", "b"]
+
+
+def test_errors():
+    with pytest.raises(hcl.HCLError):
+        hcl.parse('a = "unterminated')
+    with pytest.raises(hcl.HCLError):
+        hcl.parse("block { unclosed")
+    with pytest.raises(hcl.HCLError):
+        hcl.parse_expression("unknown_fn()")(hcl.EvalContext())
+    with pytest.raises(hcl.HCLError):
+        hcl.parse_expression("missing_var")(hcl.EvalContext())
