@@ -364,11 +364,11 @@ class HTTPAgent:
         submitting the plan (SURVEY.md §3.3, nomad/job_endpoint Job.Plan)."""
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
-        self._enforce_ns(query, "submit-job")
         payload = body.get("job") if isinstance(body, dict) else None
         if payload is None:
             raise APIError(400, "missing 'job' in body")
         job = decode_job(payload)
+        self._enforce_obj_ns(query, job.namespace or "default", "submit-job")
         from ..scheduler.annotate import plan_job
 
         return plan_job(self.server.store, job)
@@ -665,10 +665,21 @@ class HTTPAgent:
         """NDJSON event stream (http.go:359 /v1/event/stream). Events are
         ACL-filtered per topic: Node events need node:read, namespaced
         topics need read-job on the event's namespace (the reference's
-        aclFilter in nomad/stream/event_broker.go)."""
-        acl = self._acl(query)
+        aclFilter in nomad/stream/event_broker.go). The token is
+        re-resolved on every poll so revocation/downgrade takes effect on
+        long-lived streams (event_broker.go checkSubscriptionACLs)."""
+        self._acl(query)  # reject bad tokens before subscribing
+        secret = query.get("_secret", "")
 
-        def event_visible(ev) -> bool:
+        def current_acl():
+            from ..server.acl import TokenError
+
+            try:
+                return self.server.acl.resolve_token(secret)
+            except TokenError:
+                return False  # token revoked mid-stream: terminate
+
+        def event_visible(ev, acl) -> bool:
             if acl is None or acl.is_management():
                 return True
             if ev.topic == "Node":
@@ -696,8 +707,11 @@ class HTTPAgent:
 
             deadline = _t.time() + wait
             while _t.time() < deadline:
+                acl = current_acl()
+                if acl is False:
+                    return  # token revoked: close the stream
                 for ev in sub.next_events(timeout=0.5):
-                    if not event_visible(ev):
+                    if not event_visible(ev, acl):
                         continue
                     yield ev.to_json()
                     n += 1
@@ -740,6 +754,7 @@ class HTTPAgent:
         return f"{self.host}:{self.port}"
 
     def handle_metrics(self, method, body, query):
+        self._enforce(query, "agent_read")
         from ..utils.metrics import global_metrics
 
         return global_metrics.snapshot()
@@ -827,6 +842,8 @@ class HTTPAgent:
         return token.to_api()
 
     def handle_acl_token_self(self, method, body, query):
+        if method != "GET":
+            raise APIError(405, "GET required")
         secret = query.get("_secret", "")
         token = self.server.store.acl_token_by_secret(secret)
         if token is None:

@@ -29,15 +29,43 @@ def _fail(msg: str) -> int:
     return 1
 
 
-def _load_jobfile(path: str) -> dict:
+def _load_jobfile(path: str, variables: dict | None = None) -> dict:
+    """Read a job file: HCL (.hcl/.nomad, the canonical format) or JSON.
+    Mirrors command/job_run.go, which feeds files through jobspec2."""
     try:
         with open(path) as f:
-            data = json.load(f)
+            src = f.read()
     except OSError as e:
         raise SystemExit(f"error: cannot read job file: {e}")
+    stripped = src.lstrip()
+    if path.endswith((".hcl", ".nomad")) or not stripped.startswith("{"):
+        from ..api.codec import encode
+        from ..jobspec import JobspecError, parse_job_file
+
+        try:
+            return encode(parse_job_file(src, variables))
+        except JobspecError as e:
+            raise SystemExit(f"error: {path}: {e}")
+    if variables:
+        raise SystemExit("error: -var only applies to HCL job files")
+    try:
+        data = json.loads(src)
     except json.JSONDecodeError as e:
         raise SystemExit(f"error: {path} is not valid JSON: {e}")
     return data.get("job", data)
+
+
+def _parse_var_flags(var_flags) -> dict:
+    out = {}
+    for spec in var_flags or []:
+        key, sep, val = spec.partition("=")
+        if not sep:
+            raise SystemExit(f"error: -var must be key=value, got {spec!r}")
+        try:
+            out[key] = json.loads(val)
+        except json.JSONDecodeError:
+            out[key] = val
+    return out
 
 
 # -- commands ---------------------------------------------------------------
@@ -69,7 +97,7 @@ def cmd_agent(args) -> int:
 
 
 def cmd_job_run(args) -> int:
-    job = _load_jobfile(args.file)
+    job = _load_jobfile(args.file, _parse_var_flags(getattr(args, "var", None)))
     c = _client(args)
     try:
         out = c.jobs.register(job)
@@ -93,7 +121,7 @@ def cmd_job_run(args) -> int:
 
 
 def cmd_job_plan(args) -> int:
-    job = _load_jobfile(args.file)
+    job = _load_jobfile(args.file, _parse_var_flags(getattr(args, "var", None)))
     c = _client(args)
     try:
         out = c.jobs.plan(job)
@@ -325,9 +353,11 @@ def build_parser() -> argparse.ArgumentParser:
     run = job.add_parser("run")
     run.add_argument("file")
     run.add_argument("-detach", action="store_true")
+    run.add_argument("-var", action="append", dest="var", metavar="key=value")
     run.set_defaults(fn=cmd_job_run)
     plan = job.add_parser("plan")
     plan.add_argument("file")
+    plan.add_argument("-var", action="append", dest="var", metavar="key=value")
     plan.set_defaults(fn=cmd_job_plan)
     status = job.add_parser("status")
     status.add_argument("job_id", nargs="?")

@@ -161,16 +161,23 @@ class GroupAsk:
     spread_weight: float
     has_spreads: bool
     num_spread_values: int
+    # AllocMetric filter accounting (structs.go AllocMetric): populated by
+    # _eligibility_for_group, surfaced on placement failures.
+    filter_stats: dict = field(default_factory=dict)
 
 
 def _eligibility_for_group(
     ct: ClusterTensors, nodes_sorted, job: Job, tg: TaskGroup
-) -> np.ndarray:
+) -> tuple[np.ndarray, dict]:
     """ready ∧ datacenter ∧ hard constraints, with per-class memoization.
 
     Constraints whose targets resolve per-node (``unique.`` attrs, node id/
     name) force per-node evaluation — the "escaped computed class" path
-    (scheduler/feasible.go:1029-1153)."""
+    (scheduler/feasible.go:1029-1153).
+
+    Also returns filter accounting for AllocMetric explainability
+    (structs.go AllocMetric.FilterNode: NodesFiltered, ConstraintFiltered
+    per reason, ClassFiltered per computed class)."""
     pn = ct.padded_n
     eligible = ct.ready.copy()
 
@@ -180,6 +187,7 @@ def _eligibility_for_group(
         if cid is not None:
             dc_ok |= ct.dc_ids == cid
     eligible &= dc_ok
+    candidates = int(eligible[: ct.num_nodes].sum())
 
     constraints = job.constraints_for_group(tg)
     # implicit driver constraints: every task's driver must be healthy
@@ -196,11 +204,13 @@ def _eligibility_for_group(
         per_class = True
 
     ok_rows = np.ones(len(ct.class_rep) if per_class else ct.num_nodes, dtype=bool)
+    reason_rows: dict[str, list[int]] = {}
     for j, i in enumerate(rows):
         node = nodes_sorted[i]
         for d in drivers:
             if not node.drivers.get(d, False):
                 ok_rows[j] = False
+                reason_rows.setdefault(f"missing drivers: {d}", []).append(j)
                 break
         if ok_rows[j]:
             for c in constraints:
@@ -208,13 +218,37 @@ def _eligibility_for_group(
                     continue  # handled dynamically / via property sets
                 if not _check_constraint(node, c):
                     ok_rows[j] = False
+                    reason_rows.setdefault(
+                        f"{c.l_target} {c.operand} {c.r_target}".strip(), []
+                    ).append(j)
                     break
+    stats: dict = {"constraint_filtered": {}, "class_filtered": {}}
     if per_class:
         class_ok = ok_rows
+        # a filtered class filters all its member nodes (feasible.go:1029)
+        class_sizes = np.bincount(
+            ct.class_ids[: ct.num_nodes][eligible[: ct.num_nodes]],
+            minlength=len(ct.class_rep),
+        )
+        class_names = {cid: name for name, cid in ct.class_vocab.items()}
+        for reason, js in reason_rows.items():
+            n = int(sum(class_sizes[j] for j in js))
+            if n:
+                stats["constraint_filtered"][reason] = n
+        for j, ok in enumerate(class_ok):
+            if not ok and class_sizes[j]:
+                stats["class_filtered"][class_names.get(j, str(j))] = int(
+                    class_sizes[j]
+                )
         eligible[: ct.num_nodes] &= class_ok[ct.class_ids[: ct.num_nodes]]
     else:
+        for reason, js in reason_rows.items():
+            n = sum(1 for j in js if eligible[j])
+            if n:
+                stats["constraint_filtered"][reason] = n
         eligible[: ct.num_nodes] &= ok_rows
-    return eligible
+    stats["nodes_filtered"] = candidates - int(eligible[: ct.num_nodes].sum())
+    return eligible, stats
 
 
 def _affinity_scores(ct, nodes_sorted, job: Job, tg: TaskGroup) -> tuple[np.ndarray, bool]:
@@ -307,7 +341,7 @@ def flatten_group_ask(
         dtype=np.float32,
     )
 
-    eligible = _eligibility_for_group(ct, nodes_sorted, job, tg)
+    eligible, filter_stats = _eligibility_for_group(ct, nodes_sorted, job, tg)
 
     job_counts = np.zeros(ct.padded_n, dtype=np.int32)
     if snap is not None:
@@ -351,4 +385,5 @@ def flatten_group_ask(
         spread_weight=sp_w,
         has_spreads=has_sp,
         num_spread_values=nv,
+        filter_stats=filter_stats,
     )

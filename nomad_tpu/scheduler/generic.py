@@ -327,6 +327,15 @@ class GenericScheduler:
                         continue
                     n_failed += 1
                     metric.coalesced_failures = 0
+                    # explainability: why nodes were filtered/exhausted
+                    # (AllocMetric, structs.go:10034-10079)
+                    fs = ga.filter_stats
+                    metric.nodes_filtered = fs.get("nodes_filtered", 0)
+                    metric.constraint_filtered = dict(
+                        fs.get("constraint_filtered", {})
+                    )
+                    metric.class_filtered = dict(fs.get("class_filtered", {}))
+                    self._record_exhaustion(metric, ct, ga)
                     self._record_failure(tg_name, metric)
                     continue
                 node_id = ct.node_ids[row]
@@ -373,6 +382,28 @@ class GenericScheduler:
                         )
                         alloc.reschedule_tracker = RescheduleTracker(events=events)
                 self.plan.append_alloc(alloc)
+
+    @staticmethod
+    def _record_exhaustion(metric, ct, ga) -> None:
+        """Count eligible nodes that lacked free capacity, per dimension
+        (BinPackIterator's 'dimension exhausted' accounting, rank.go:483)."""
+        import numpy as np
+
+        from ..structs.resources import RESOURCE_DIMS
+
+        elig = ga.eligible[: ct.num_nodes]
+        if not elig.any():
+            return
+        free = (ct.capacity - ct.used)[: ct.num_nodes][elig]
+        short = free < ga.ask[None, :]
+        exhausted = short.any(axis=1)
+        metric.nodes_exhausted = int(exhausted.sum())
+        for d, dim in enumerate(RESOURCE_DIMS):
+            n = int(short[:, d].sum())
+            if n:
+                metric.dimension_exhausted[dim] = (
+                    metric.dimension_exhausted.get(dim, 0) + n
+                )
 
     def _preemption_enabled(self) -> bool:
         cfg = self.scheduler_config

@@ -83,6 +83,23 @@ def _scan_quoted(src: str, pos: int, line: int) -> tuple[list, int]:
             )
             i += 2
             continue
+        if c == "$" and i + 1 < n and src[i + 1] == "$":
+            # HCL2 '$${' escape: literal '${' deferred to runtime
+            if i + 2 < n and src[i + 2] == "{":
+                lit.append("${")
+                i += 3
+                depth = 1
+                while i < n and depth:
+                    if src[i] == "{":
+                        depth += 1
+                    elif src[i] == "}":
+                        depth -= 1
+                    lit.append(src[i])
+                    i += 1
+                continue
+            lit.append("$")
+            i += 1
+            continue
         if c == "$" and i + 1 < n and src[i + 1] == "{":
             if lit:
                 parts.append("".join(lit))
@@ -304,9 +321,10 @@ _STD_FUNCTIONS: dict[str, Callable] = {
         xs[i : i + int(size)] for i in range(0, len(xs), int(size))
     ],
     "regex": lambda pat, s: (re.search(pat, s) or [""])[0],
-    "can": lambda v: True,
-    "try": lambda *xs: next((x for x in xs if x is not None), None),
 }
+# try()/can() are NOT in this table: they must see their arguments
+# UNevaluated to catch evaluation errors (cty semantics) — special-cased
+# in _call.
 
 
 # ---------------------------------------------------------------------------
@@ -561,12 +579,14 @@ class _Parser:
                 if kt.kind == "ident":
                     kexpr: Expr = lambda ctx, k=kt.value: k
                 elif kt.kind == "string":
-                    parts = kt.value
-                    kexpr = (
-                        lambda ctx, p=parts: "".join(
-                            x if isinstance(x, str) else ""
-                            for x in p
-                        )
+                    # interpolated keys evaluate like string values
+                    compiled_key = [
+                        p if isinstance(p, str) else parse_expression(p[1])
+                        for p in kt.value
+                    ]
+                    kexpr = lambda ctx, cp=tuple(compiled_key): "".join(
+                        p if isinstance(p, str) else _to_string(p(ctx))
+                        for p in cp
                     )
                 elif kt.kind == "op" and kt.value == "(":
                     kexpr = self.parse_expr()
@@ -602,6 +622,20 @@ def _index(obj: Any, idx: Any) -> Any:
 
 
 def _call(ctx: EvalContext, name: str, args: tuple, spread: bool, tok: Token) -> Any:
+    if name == "try":
+        # first argument that evaluates without error
+        for a in args:
+            try:
+                return a(ctx)
+            except (HCLError, IndexError, KeyError, TypeError):
+                continue
+        raise HCLError("try(): no argument evaluated successfully", tok.line, tok.col)
+    if name == "can":
+        try:
+            args[0](ctx) if args else None
+            return True
+        except (HCLError, IndexError, KeyError, TypeError):
+            return False
     fn = ctx.functions.get(name)
     if fn is None:
         raise HCLError(f"unknown function {name!r}", tok.line, tok.col)

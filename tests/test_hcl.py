@@ -124,6 +124,28 @@ def test_multiline_lists():
     assert body.attrs["xs"].expr(hcl.EvalContext()) == ["a", "b"]
 
 
+def test_dollar_escape():
+    """'$${' defers interpolation to runtime (HCL2 escape)."""
+    body = hcl.parse('cmd = "$${NOMAD_ADDR_http}"\nmoney = "a$$b"')
+    ctx = hcl.EvalContext()
+    assert body.attrs["cmd"].expr(ctx) == "${NOMAD_ADDR_http}"
+    assert body.attrs["money"].expr(ctx) == "a$$b"
+
+
+def test_try_and_can_are_lazy():
+    ctx = hcl.EvalContext({"var": {"x": 1}})
+    assert hcl.parse_expression('try(var.missing, "fallback")')(ctx) == "fallback"
+    assert hcl.parse_expression("try(var.x, 99)")(ctx) == 1
+    assert hcl.parse_expression("can(var.missing)")(ctx) is False
+    assert hcl.parse_expression("can(var.x)")(ctx) is True
+
+
+def test_interpolated_object_keys():
+    ctx = hcl.EvalContext({"var": {"k": "key1"}})
+    body = hcl.parse('m = { "${var.k}" = "v", plain = 2 }')
+    assert body.attrs["m"].expr(ctx) == {"key1": "v", "plain": 2}
+
+
 def test_errors():
     with pytest.raises(hcl.HCLError):
         hcl.parse('a = "unterminated')

@@ -1,0 +1,373 @@
+"""Jobspec parser tests — HCL job files → Job structs.
+
+Mirrors jobspec/parse_test.go shapes (the canonical example job) and
+jobspec2's variable/locals evaluation.
+"""
+
+import pytest
+
+from nomad_tpu.jobspec import JobspecError, parse_duration, parse_job_file
+
+EXAMPLE = """
+job "example" {
+  region      = "global"
+  datacenters = ["dc1", "dc2"]
+  type        = "service"
+  priority    = 70
+
+  meta {
+    owner = "team-core"
+  }
+
+  constraint {
+    attribute = "${attr.kernel.name}"
+    value     = "linux"
+  }
+
+  update {
+    max_parallel      = 2
+    min_healthy_time  = "15s"
+    healthy_deadline  = "5m"
+    progress_deadline = "10m"
+    auto_revert       = true
+    canary            = 1
+  }
+
+  group "web" {
+    count = 3
+
+    constraint {
+      distinct_hosts = true
+    }
+
+    affinity {
+      attribute = "${node.datacenter}"
+      value     = "dc1"
+      weight    = 75
+    }
+
+    spread {
+      attribute = "${node.datacenter}"
+      weight    = 50
+      target "dc1" { percent = 70 }
+      target "dc2" { percent = 30 }
+    }
+
+    restart {
+      attempts = 3
+      interval = "30m"
+      delay    = "10s"
+      mode     = "delay"
+    }
+
+    reschedule {
+      attempts       = 5
+      interval       = "1h"
+      delay          = "45s"
+      delay_function = "fibonacci"
+      unlimited      = false
+    }
+
+    ephemeral_disk {
+      size   = 500
+      sticky = true
+    }
+
+    network {
+      mbits = 20
+      port "http" {}
+      port "admin" { static = 8080 }
+    }
+
+    task "server" {
+      driver = "exec"
+      user   = "www"
+
+      config {
+        command = "/bin/server"
+        args    = ["-port", "8080"]
+      }
+
+      env {
+        DB_HOST = "db.internal"
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+      }
+
+      lifecycle {
+        hook    = "prestart"
+        sidecar = false
+      }
+
+      kill_timeout = "25s"
+
+      meta {
+        tier = "frontend"
+      }
+    }
+
+    task "logger" {
+      driver = "raw_exec"
+      leader = true
+      resources {
+        cpu    = 100
+        memory = 64
+      }
+    }
+  }
+
+  group "batchers" {
+    count = 1
+    task "worker" {
+      driver = "exec"
+    }
+  }
+}
+"""
+
+
+def test_parse_example_job():
+    job = parse_job_file(EXAMPLE)
+    assert job.id == "example"
+    assert job.type == "service"
+    assert job.priority == 70
+    assert job.datacenters == ["dc1", "dc2"]
+    assert job.meta == {"owner": "team-core"}
+    # job-level constraint with interpolation kept literal at job level?
+    # -> ${attr.kernel.name} must survive as the constraint l_target
+    assert job.constraints[0].l_target == "${attr.kernel.name}"
+    assert job.constraints[0].r_target == "linux"
+
+    web = job.task_groups[0]
+    assert web.name == "web" and web.count == 3
+    assert web.constraints[0].operand == "distinct_hosts"
+    assert web.affinities[0].weight == 75
+    sp = web.spreads[0]
+    assert sp.attribute == "${node.datacenter}"
+    assert {t.value: t.percent for t in sp.targets} == {"dc1": 70, "dc2": 30}
+    assert web.restart_policy.attempts == 3
+    assert web.restart_policy.interval_s == 1800.0
+    assert web.reschedule_policy.attempts == 5
+    assert web.reschedule_policy.delay_function == "fibonacci"
+    assert not web.reschedule_policy.unlimited
+    assert web.ephemeral_disk.size_mb == 500 and web.ephemeral_disk.sticky
+    assert web.networks[0].mbits == 20
+    assert web.networks[0].dynamic_ports == ["http"]
+    assert web.networks[0].reserved_ports == [8080]
+
+    # job-level update{} propagates to groups without their own
+    assert web.update is not None
+    assert web.update.max_parallel == 2
+    assert web.update.min_healthy_time_s == 15.0
+    assert web.update.auto_revert and web.update.canary == 1
+
+    server = web.tasks[0]
+    assert server.name == "server" and server.driver == "exec"
+    assert server.user == "www"
+    assert server.config["command"] == "/bin/server"
+    assert server.config["args"] == ["-port", "8080"]
+    assert server.env == {"DB_HOST": "db.internal"}
+    assert server.resources.cpu == 500
+    assert server.resources.memory_mb == 256
+    assert server.lifecycle_hook == "prestart"
+    assert server.kill_timeout_s == 25.0
+    assert server.meta == {"tier": "frontend"}
+
+    logger = web.tasks[1]
+    assert logger.leader and logger.driver == "raw_exec"
+
+    assert job.task_groups[1].name == "batchers"
+
+
+def test_variables_and_locals():
+    src = """
+    variable "count" { default = 2 }
+    variable "dc" { default = "dc1" }
+    locals {
+      full_name = "web-${var.dc}"
+    }
+    job "v" {
+      datacenters = [var.dc]
+      group "g" {
+        count = var.count * 2
+        task "t" {
+          driver = "exec"
+          env { NAME = local.full_name }
+        }
+      }
+    }
+    """
+    job = parse_job_file(src)
+    assert job.datacenters == ["dc1"]
+    assert job.task_groups[0].count == 4
+    assert job.task_groups[0].tasks[0].env["NAME"] == "web-dc1"
+    # -var override
+    job2 = parse_job_file(src, {"count": 5, "dc": "dc9"})
+    assert job2.task_groups[0].count == 10
+    assert job2.datacenters == ["dc9"]
+
+
+def test_variable_missing_and_undeclared():
+    src = 'variable "x" {}\njob "j" { group "g" { task "t" { driver = "exec" } } }'
+    with pytest.raises(JobspecError, match="no value"):
+        parse_job_file(src)
+    assert parse_job_file(src, {"x": 1}).id == "j"
+    with pytest.raises(JobspecError, match="undeclared"):
+        parse_job_file(src, {"x": 1, "bogus": 2})
+
+
+def test_periodic_and_parameterized():
+    job = parse_job_file(
+        """
+        job "cron" {
+          type = "batch"
+          periodic {
+            cron             = "*/15 * * * *"
+            prohibit_overlap = true
+          }
+          group "g" { task "t" { driver = "exec" } }
+        }
+        """
+    )
+    assert job.is_periodic()
+    assert job.periodic.spec == "*/15 * * * *"
+    assert job.periodic.prohibit_overlap
+
+    job2 = parse_job_file(
+        """
+        job "batch" {
+          type = "batch"
+          parameterized {
+            payload       = "required"
+            meta_required = ["input"]
+          }
+          group "g" { task "t" { driver = "exec" } }
+        }
+        """
+    )
+    assert job2.is_parameterized()
+    assert job2.parameterized.payload == "required"
+    assert job2.parameterized.meta_required == ["input"]
+
+
+def test_constraint_shorthands():
+    job = parse_job_file(
+        """
+        job "c" {
+          constraint {
+            attribute = "${attr.driver.exec.version}"
+            version   = ">= 1.2"
+          }
+          constraint {
+            attribute = "${meta.rack}"
+            regexp    = "r[0-9]+"
+          }
+          group "g" {
+            constraint { distinct_property = "${meta.rack}" }
+            task "t" { driver = "exec" }
+          }
+        }
+        """
+    )
+    assert job.constraints[0].operand == "version"
+    assert job.constraints[0].r_target == ">= 1.2"
+    assert job.constraints[1].operand == "regexp"
+    assert job.task_groups[0].constraints[0].operand == "distinct_property"
+    assert job.task_groups[0].constraints[0].l_target == "${meta.rack}"
+
+
+def test_device_asks():
+    job = parse_job_file(
+        """
+        job "ml" {
+          group "g" {
+            task "train" {
+              driver = "exec"
+              resources {
+                cpu    = 1000
+                memory = 4096
+                device "nvidia/gpu" {
+                  count = 2
+                  constraint {
+                    attribute = "${device.attr.memory}"
+                    operator  = ">="
+                    value     = "8 GiB"
+                  }
+                }
+              }
+            }
+          }
+        }
+        """
+    )
+    dev = job.task_groups[0].tasks[0].resources.devices[0]
+    assert dev.name == "nvidia/gpu" and dev.count == 2
+    assert dev.constraints[0].operand == ">="
+
+
+def test_parse_duration():
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("5m") == 300.0
+    assert parse_duration("1h30m") == 5400.0
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration(42) == 42.0
+    with pytest.raises(JobspecError):
+        parse_duration("bogus")
+    with pytest.raises(JobspecError):
+        parse_duration("5x")
+
+
+def test_errors():
+    with pytest.raises(JobspecError, match="no job block"):
+        parse_job_file('group "g" {}')
+    with pytest.raises(JobspecError, match="no groups"):
+        parse_job_file('job "j" {}')
+    with pytest.raises(JobspecError, match="no tasks"):
+        parse_job_file('job "j" { group "g" {} }')
+    with pytest.raises(JobspecError, match="invalid job type"):
+        parse_job_file(
+            'job "j" { type = "bogus"\n group "g" { task "t" { driver = "exec" } } }'
+        )
+
+
+def test_failed_placement_metrics_explain_filtering():
+    """An unplaceable job's eval must carry AllocMetric filter accounting
+    (structs.go:10034-10079 — nodes_filtered, constraint_filtered)."""
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler import Harness
+
+    h = Harness()
+    for i in range(3):
+        h.store.upsert_node(i + 1, mock.node())
+    job = parse_job_file(
+        """
+        job "nope" {
+          group "g" {
+            task "t" { driver = "no_such_driver" }
+          }
+        }
+        """
+    )
+    h.store.upsert_job(h.next_index(), job)
+    ev = mock.eval_for(job)
+    h.store.upsert_evals(h.next_index(), [ev])
+    h.process(ev)
+    m = h.evals[-1].failed_tg_allocs["g"]
+    assert m.nodes_filtered == 3
+    assert m.constraint_filtered == {"missing drivers: no_such_driver": 3}
+
+
+def test_roundtrip_through_api_codec():
+    """HCL → Job → encode → decode_job keeps the scheduling surface."""
+    from nomad_tpu.api.codec import decode_job, encode
+
+    job = parse_job_file(EXAMPLE)
+    job2 = decode_job(encode(job))
+    assert job2.id == job.id
+    assert len(job2.task_groups) == 2
+    assert job2.task_groups[0].tasks[0].resources.cpu == 500
+    assert job2.task_groups[0].spreads[0].targets[0].percent == 70
+    assert job2.task_groups[0].update.canary == 1
