@@ -513,7 +513,10 @@ class HTTPAgent:
 
         enable = bool(body.get("drain_enabled", True)) if body else True
         drain = (
-            DrainStrategy(deadline_s=float(body.get("deadline_s", 3600)))
+            DrainStrategy(
+                deadline_s=float(body.get("deadline_s", 3600)),
+                ignore_system_jobs=bool(body.get("ignore_system_jobs", False)),
+            )
             if enable
             else None
         )

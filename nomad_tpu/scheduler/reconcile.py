@@ -241,11 +241,19 @@ def reconcile(
                     )
                     counts["stop"] += 1
                     replace.append((a, ""))
-                else:
-                    # draining ⇒ migrate: stop here, place elsewhere
+                elif a.desired_transition.migrate:
+                    # draining migrates wave-by-wave: only allocs the
+                    # NodeDrainer marked (DesiredTransition.ShouldMigrate,
+                    # reconcile_util.go filterByTainted) move now —
+                    # migrate.max_parallel is enforced by the drainer
                     r.stop.append(StopRequest(a, REASON_NODE_TAINTED))
                     counts["migrate"] += 1
                     replace.append((a, a.node_id))
+                else:
+                    # still on a draining node, waiting for its wave
+                    keep.append(a)
+                    r.ignore.append(a)
+                    counts["ignore"] += 1
                 continue
 
             keep.append(a)

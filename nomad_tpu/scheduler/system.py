@@ -74,10 +74,15 @@ class SystemScheduler:
             if node is not None:
                 if node.terminal_status():
                     self.plan.append_lost_alloc(a)
-                else:
+                elif a.desired_transition.migrate:
+                    # draining: wait for the NodeDrainer's wave mark
+                    # (reconcile_util.go filterByTainted — system allocs
+                    # leave a draining node only when marked migrating)
                     self.plan.append_stopped_alloc(
                         a, "alloc stopped because node is draining"
                     )
+                else:
+                    live_by_node_group[(a.node_id, a.task_group)] = a
                 continue
             live_by_node_group[(a.node_id, a.task_group)] = a
 
@@ -145,12 +150,15 @@ class SystemScheduler:
                         metrics=metric,
                     )
                 )
-            # stop allocs on nodes no longer eligible (e.g. constraint change)
+            # stop allocs on nodes no longer eligible (e.g. constraint
+            # change) — but NOT draining nodes: those drain via the
+            # NodeDrainer's migrate marks, not eligibility loss
             eligible_ids = {ct.node_ids[r] for r in eligible_rows}
             for (node_id, tg_name), a in list(live_by_node_group.items()):
                 if (
                     tg_name == tg.name
                     and node_id not in eligible_ids
+                    and node_id not in tainted
                     and not a.terminal_status()
                 ):
                     self.plan.append_stopped_alloc(a, REASON_ALLOC_NOT_NEEDED)

@@ -78,9 +78,11 @@ class Server:
         from ..broker.event_broker import EventBroker as StreamBroker
         from .core_gc import CoreScheduler
         from .deployment_watcher import DeploymentWatcher
+        from .drainer import NodeDrainer
         from .heartbeat import NodeHeartbeater
         from .periodic import PeriodicDispatch
 
+        self.drainer = NodeDrainer(self)
         self.heartbeater = NodeHeartbeater(self, ttl=self.config.heartbeat_ttl)
         self.deployment_watcher = DeploymentWatcher(
             self, interval=self.config.deployment_watch_interval
@@ -127,6 +129,7 @@ class Server:
         self.blocked_evals.set_enabled(True)
         self.heartbeater.start()
         self.deployment_watcher.start()
+        self.drainer.start()
         self.periodic.restore()
         self.periodic.start()
         self.core_gc.start()
@@ -142,6 +145,7 @@ class Server:
         self.workers.clear()
         self.heartbeater.stop()
         self.deployment_watcher.stop()
+        self.drainer.stop()
         self.periodic.stop()
         self.core_gc.stop()
         self.plan_apply_loop.stop()
@@ -282,9 +286,29 @@ class Server:
         return self._create_node_evals(node_id)
 
     def update_node_drain(self, node_id: str, drain) -> list[Evaluation]:
-        self._raft_apply(
-            lambda index: self.store.update_node_drain(index, node_id, drain)
-        )
+        """Node.UpdateDrain: stamp the force deadline and commit; the
+        NodeDrainer picks the node up on its next scan. Cancelling a
+        drain clears any pending migrate marks so wave accounting and
+        future drains start clean (drainer.go Remove)."""
+        import time as _t
+
+        if drain is not None and drain.deadline_s > 0 and not drain.force_deadline_unix:
+            drain.force_deadline_unix = _t.time() + drain.deadline_s
+
+        resets = {}
+        if drain is None:
+            from ..structs.alloc import DesiredTransition as _DT
+
+            for a in self.store.allocs_by_node(node_id):
+                if not a.terminal_status() and a.desired_transition.migrate:
+                    resets[a.id] = _DT(migrate=False)
+
+        def apply(index):
+            self.store.update_node_drain(index, node_id, drain)
+            if resets:
+                self.store.update_allocs_desired_transition(index, resets)
+
+        self._raft_apply(apply)
         return self._create_node_evals(node_id)
 
     def _create_node_evals(self, node_id: str) -> list[Evaluation]:

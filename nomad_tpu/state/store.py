@@ -357,7 +357,12 @@ class StateStore(StateSnapshot):
             nodes[node_id] = n2
             self._bump(index, "nodes")
 
-    def update_node_drain(self, index: int, node_id: str, drain) -> None:
+    def update_node_drain(
+        self, index: int, node_id: str, drain, eligibility: str = ""
+    ) -> None:
+        """Set/clear the drain strategy. ``eligibility`` overrides the
+        default (draining ⇒ ineligible, cleared ⇒ eligible) — the drainer
+        clears the strategy but keeps the node ineligible."""
         from ..structs import NODE_SCHED_INELIGIBLE, NODE_SCHED_ELIGIBLE
 
         with self._lock:
@@ -369,7 +374,7 @@ class StateStore(StateSnapshot):
 
             n2 = copy.copy(n)
             n2.drain = drain
-            n2.scheduling_eligibility = (
+            n2.scheduling_eligibility = eligibility or (
                 NODE_SCHED_INELIGIBLE if drain is not None else NODE_SCHED_ELIGIBLE
             )
             n2.modify_index = index
@@ -658,6 +663,25 @@ class StateStore(StateSnapshot):
                     )
                     a2.modify_index = index
                     table[aid] = a2
+            self._bump(index, "allocs")
+
+    def update_allocs_desired_transition(
+        self, index: int, transitions: dict[str, object]
+    ) -> None:
+        """Set DesiredTransition per alloc (the drainer's migrate marks —
+        state_store.go UpdateAllocsDesiredTransitions)."""
+        import copy as _copy
+
+        with self._lock:
+            table = self._own("allocs")
+            for aid, tr in transitions.items():
+                a = table.get(aid)
+                if a is None:
+                    continue
+                a2 = _copy.copy(a)
+                a2.desired_transition = tr
+                a2.modify_index = index
+                table[aid] = a2
             self._bump(index, "allocs")
 
     # -- ACL ---------------------------------------------------------------

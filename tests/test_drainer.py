@@ -1,0 +1,255 @@
+"""NodeDrainer tests — wave-by-wave migration off draining nodes.
+
+Mirrors nomad/drainer/ behavior: migrate.max_parallel waves
+(watch_jobs.go handleTaskGroup), system jobs last (watch_nodes.go),
+deadline force-drain (drain_heap.go), drain-complete clears the strategy
+but keeps the node ineligible (drainer.go handleDoneNodeDrains).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs import DrainStrategy
+from nomad_tpu.structs.job import MigrateStrategy
+
+
+@pytest.fixture
+def server():
+    s = Server(ServerConfig(num_workers=2, heartbeat_ttl=60.0))
+    s.establish_leadership()
+    # fake client: pending allocs come up "running" shortly after
+    # placement (drain waves gate on replacement health)
+    import threading
+
+    stop = threading.Event()
+
+    def client_loop():
+        import copy
+
+        while not stop.wait(0.05):
+            updates = []
+            for a in list(s.store.allocs()):
+                if a.desired_status == "run" and a.client_status == "pending":
+                    u = copy.copy(a)
+                    u.client_status = "running"
+                    updates.append(u)
+            if updates:
+                s.update_allocs_from_client(updates)
+
+    t = threading.Thread(target=client_loop, daemon=True)
+    t.start()
+    yield s
+    stop.set()
+    t.join(timeout=2)
+    s.shutdown()
+
+
+def wait_until(fn, timeout=8.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def live_allocs_on(server, node_id):
+    return [
+        a
+        for a in server.store.allocs_by_node(node_id)
+        if not a.terminal_status() and a.desired_status == "run"
+    ]
+
+
+def test_drain_migrates_allocs_to_other_nodes(server):
+    nodes = [mock.node() for _ in range(3)]
+    for n in nodes:
+        server.register_node(n)
+    job = mock.job()  # count=10
+    server.register_job(job)
+    assert server.wait_for_evals(10)
+
+    victim = max(
+        nodes, key=lambda n: len(server.store.allocs_by_node(n.id))
+    )
+    n_before = len(live_allocs_on(server, victim.id))
+    assert n_before > 0
+
+    server.update_node_drain(victim.id, DrainStrategy(deadline_s=3600))
+    # all allocs leave the victim; job stays at full count elsewhere
+    assert wait_until(lambda: not live_allocs_on(server, victim.id))
+    assert wait_until(
+        lambda: sum(
+            1
+            for a in server.store.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status() and a.desired_status == "run"
+        )
+        == 10
+    )
+    for a in server.store.allocs_by_job(job.namespace, job.id):
+        if not a.terminal_status():
+            assert a.node_id != victim.id
+    # drain completes: strategy cleared, node stays ineligible
+    assert wait_until(
+        lambda: server.store.node_by_id(victim.id).drain is None
+    )
+    assert (
+        server.store.node_by_id(victim.id).scheduling_eligibility
+        == "ineligible"
+    )
+
+
+def test_drain_respects_max_parallel_waves(server):
+    """With migrate.max_parallel=1 the drainer must never mark more than
+    one alloc of the group migrating at a time."""
+    n1, n2 = mock.node(), mock.node()
+    server.register_node(n1)
+    server.register_node(n2)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+    server.register_job(job)
+    assert server.wait_for_evals(10)
+
+    victim = max(
+        (n1, n2), key=lambda n: len(server.store.allocs_by_node(n.id))
+    )
+    if not live_allocs_on(server, victim.id):
+        pytest.skip("all allocs landed on one node unexpectedly")
+    # steady state first: everything running before the drain starts
+    assert wait_until(
+        lambda: all(
+            a.client_status == "running"
+            for a in server.store.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        )
+    )
+
+    # observe over time: the group must never dip below
+    # count − max_parallel serving (running/unmarked) allocs — the
+    # whole point of wave pacing (watch_jobs.go threshold)
+    min_serving = 99
+    server.update_node_drain(victim.id, DrainStrategy(deadline_s=3600))
+    deadline = time.time() + 12
+    while time.time() < deadline:
+        serving = [
+            a
+            for a in server.store.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+            and not a.desired_transition.migrate
+            and (a.client_status == "running" or a.node_id == victim.id)
+        ]
+        min_serving = min(min_serving, len(serving))
+        if not live_allocs_on(server, victim.id):
+            break
+        time.sleep(0.02)
+    assert not live_allocs_on(server, victim.id)
+    assert min_serving >= job.task_groups[0].count - 1
+
+
+def test_drain_cancel_clears_migrate_marks(server):
+    """Cancelling a drain resets DesiredTransition.migrate so wave
+    accounting and future drains start clean (drainer.go Remove)."""
+    n1, n2 = mock.node(), mock.node()
+    server.register_node(n1)
+    server.register_node(n2)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+    server.register_job(job)
+    assert server.wait_for_evals(10)
+    victim = max(
+        (n1, n2), key=lambda n: len(server.store.allocs_by_node(n.id))
+    )
+    server.update_node_drain(victim.id, DrainStrategy(deadline_s=3600))
+    assert wait_until(
+        lambda: any(
+            a.desired_transition.migrate
+            for a in server.store.allocs_by_job(job.namespace, job.id)
+        )
+    )
+    server.update_node_drain(victim.id, None)
+    assert wait_until(
+        lambda: not any(
+            a.desired_transition.migrate
+            for a in server.store.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        )
+    )
+    assert server.store.node_by_id(victim.id).drain is None
+
+
+def test_drain_deadline_forces_remaining(server):
+    """A tiny deadline force-marks everything immediately."""
+    n1, n2 = mock.node(), mock.node()
+    server.register_node(n1)
+    server.register_node(n2)
+    job = mock.job()
+    job.task_groups[0].count = 6
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+    server.register_job(job)
+    assert server.wait_for_evals(10)
+    victim = max(
+        (n1, n2), key=lambda n: len(server.store.allocs_by_node(n.id))
+    )
+    server.update_node_drain(victim.id, DrainStrategy(deadline_s=-1))
+    assert wait_until(lambda: not live_allocs_on(server, victim.id), timeout=5)
+
+
+def test_drain_system_jobs_last(server):
+    n1, n2 = mock.node(), mock.node()
+    server.register_node(n1)
+    server.register_node(n2)
+    sysjob = mock.system_job()
+    server.register_job(sysjob)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    server.register_job(job)
+    assert server.wait_for_evals(10)
+
+    victim = n1
+    sys_allocs = [
+        a
+        for a in server.store.allocs_by_node(victim.id)
+        if a.job_id == sysjob.id and not a.terminal_status()
+    ]
+    assert sys_allocs, "system job should land on every node"
+
+    server.update_node_drain(victim.id, DrainStrategy(deadline_s=3600))
+    assert wait_until(
+        lambda: not [
+            a
+            for a in live_allocs_on(server, victim.id)
+            if a.job_id != sysjob.id
+        ]
+    )
+    # then the system allocs are drained too
+    assert wait_until(lambda: not live_allocs_on(server, victim.id))
+    assert wait_until(lambda: server.store.node_by_id(victim.id).drain is None)
+
+
+def test_drain_ignore_system_jobs(server):
+    n1, n2 = mock.node(), mock.node()
+    server.register_node(n1)
+    server.register_node(n2)
+    sysjob = mock.system_job()
+    server.register_job(sysjob)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    server.register_job(job)
+    assert server.wait_for_evals(10)
+
+    victim = n1
+    server.update_node_drain(
+        victim.id,
+        DrainStrategy(deadline_s=3600, ignore_system_jobs=True),
+    )
+    # service allocs leave; system alloc stays; drain completes anyway
+    assert wait_until(
+        lambda: server.store.node_by_id(victim.id).drain is None
+    )
+    remaining = live_allocs_on(server, victim.id)
+    assert remaining and all(a.job_id == sysjob.id for a in remaining)
