@@ -161,6 +161,10 @@ class GroupAsk:
     spread_weight: float
     has_spreads: bool
     num_spread_values: int
+    # Per-node cap on additional placements of this group, from device
+    # instance accounting (scheduler/device.py feasible_sets); None when
+    # the group asks for no devices (kernel substitutes +inf).
+    slot_caps: np.ndarray | None = None
     # AllocMetric filter accounting (structs.go AllocMetric): populated by
     # _eligibility_for_group, surfaced on placement failures.
     filter_stats: dict = field(default_factory=dict)
@@ -315,6 +319,63 @@ def _spread_tensors(ct, nodes_sorted, job: Job, tg: TaskGroup, snap, total_desir
     return node_vals, desired, counts, weight, True, nv
 
 
+def _device_slot_caps(
+    ct, nodes_sorted, snap, tg, count, eligible, filter_stats
+):
+    """Device feasibility → dense per-node slot caps + device affinity.
+
+    Returns (slot_caps f32[N] | None, dev_aff f32[N], has_dev_aff bool).
+    Nodes that can't satisfy even one set of the group's device asks are
+    filtered hard (DeviceChecker, feasible.go:1173); the cap feeds the
+    in-batch accounting in the placement scan.
+    """
+    from ..scheduler.device import (
+        collect_in_use,
+        feasible_sets,
+        group_device_asks,
+        node_device_affinity,
+    )
+
+    if not group_device_asks(tg):
+        return None, np.zeros(ct.padded_n, dtype=np.float32), False
+
+    slot_caps = np.zeros(ct.padded_n, dtype=np.float32)
+    dev_aff = np.zeros(ct.padded_n, dtype=np.float32)
+    has_dev_aff = False
+    filtered = 0
+    for i in range(ct.num_nodes):
+        if not eligible[i]:
+            continue
+        node = nodes_sorted[i]
+        in_use = (
+            collect_in_use(snap.allocs_by_node(node.id))
+            if snap is not None
+            else {}
+        )
+        sets = feasible_sets(node, in_use, tg, count)
+        slot_caps[i] = sets
+        if sets == 0 and feasible_sets(node, {}, tg, 1) == 0:
+            # no matching device *hardware* at all — hard filter
+            # (DeviceChecker, feasible.go:1173). Nodes whose devices are
+            # merely held by other allocs keep eligible=True with
+            # slot_caps=0: the scan can't place there, but the preemption
+            # fallback still may (PreemptForDevice's candidate set).
+            eligible[i] = False
+            filtered += 1
+        elif sets > 0:
+            s, has = node_device_affinity(node, tg)
+            if has:
+                dev_aff[i] = s
+                has_dev_aff = True
+    if filtered:
+        cf = filter_stats.setdefault("constraint_filtered", {})
+        cf["missing devices"] = cf.get("missing devices", 0) + filtered
+        filter_stats["nodes_filtered"] = (
+            filter_stats.get("nodes_filtered", 0) + filtered
+        )
+    return slot_caps, dev_aff, has_dev_aff
+
+
 def flatten_group_ask(
     ct: ClusterTensors,
     snap,
@@ -359,6 +420,14 @@ def flatten_group_ask(
             penalty[row] = True
 
     aff, has_aff = _affinity_scores(ct, nodes_sorted, job, tg)
+    slot_caps, dev_aff, has_dev_aff = _device_slot_caps(
+        ct, nodes_sorted, snap, tg, count, eligible, filter_stats
+    )
+    if has_dev_aff:
+        # matched device affinity folds into the node-affinity component
+        # (rank.go:388-434 adds the assignment's affinity sum to the score)
+        aff = (aff + dev_aff) / (2.0 if has_aff else 1.0)
+        has_aff = True
     sp_vals, sp_desired, sp_counts, sp_w, has_sp, nv = _spread_tensors(
         ct, nodes_sorted, job, tg, snap, tg.count
     )
@@ -385,5 +454,6 @@ def flatten_group_ask(
         spread_weight=sp_w,
         has_spreads=has_sp,
         num_spread_values=nv,
+        slot_caps=slot_caps,
         filter_stats=filter_stats,
     )

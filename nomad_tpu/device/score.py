@@ -135,6 +135,7 @@ def _place_scan(
     spread_weight,
     has_spreads,
     distinct_hosts,
+    slot_caps,  # f32[N] max additional placements per node (device sets)
     algorithm_spread,
     count,  # i32[] actual placements wanted (≤ max_steps)
     max_steps: int,
@@ -144,11 +145,13 @@ def _place_scan(
     Each step scores all nodes against the *current* proposed usage (the
     device-resident analog of ProposedAllocs, scheduler/context.go:120-157),
     picks the argmax, and folds the placement into the state. Steps past
-    ``count`` (or with no feasible node) emit choice −1.
+    ``count`` (or with no feasible node) emit choice −1. ``slot_caps``
+    bounds per-node placements of *this* group — the dense form of the
+    DeviceChecker/DeviceAccounter limit (scheduler/device.py).
     """
 
     def step(state, i):
-        used, job_counts, spread_counts = state
+        used, job_counts, spread_counts, placed = state
         boost = _spread_boost(
             spread_value_ids, spread_desired, spread_counts, spread_weight
         )
@@ -156,7 +159,7 @@ def _place_scan(
             capacity,
             used,
             ask,
-            eligible,
+            eligible & (placed < slot_caps),
             job_counts,
             desired_total,
             penalty_nodes,
@@ -174,16 +177,18 @@ def _place_scan(
         onehot = (jnp.arange(used.shape[0]) == best) & ok
         used = used + jnp.where(onehot[:, None], ask[None, :], 0.0)
         job_counts = job_counts + onehot.astype(job_counts.dtype)
+        placed = placed + onehot.astype(placed.dtype)
         vid = jnp.maximum(spread_value_ids[best], 0)
         bump = ok & (spread_value_ids[best] >= 0)
         spread_counts = spread_counts.at[vid].add(jnp.where(bump, 1.0, 0.0))
-        return (used, job_counts, spread_counts), (
+        return (used, job_counts, spread_counts, placed), (
             choice.astype(jnp.int32),
             jnp.where(ok, best_score, -jnp.inf).astype(jnp.float32),
         )
 
-    state0 = (used0, job_counts0, spread_counts0)
-    (used, job_counts, spread_counts), (choices, scores) = jax.lax.scan(
+    placed0 = jnp.zeros(used0.shape[0], dtype=jnp.float32)
+    state0 = (used0, job_counts0, spread_counts0, placed0)
+    (used, job_counts, spread_counts, _placed), (choices, scores) = jax.lax.scan(
         step, state0, jnp.arange(max_steps)
     )
     return choices, scores, used
@@ -206,6 +211,7 @@ def place_batch_kernel(
     spread_weights,  # f32[G]
     has_spreads,  # bool[G]
     distinct_hosts,  # bool[G]
+    slot_caps,  # f32[G, N] per-node device-set caps (+inf when no devices)
     algorithm_spread,  # bool[]
     counts,  # i32[G]
     max_steps: int,
@@ -218,7 +224,7 @@ def place_batch_kernel(
     partially rejects on conflict (nomad/plan_apply.go:439-596).
     """
     return jax.vmap(
-        lambda a, e, jc, dt, pn, af, ha, svi, sd, sc, sw, hs, dh, c: _place_scan(
+        lambda a, e, jc, dt, pn, af, ha, svi, sd, sc, sw, hs, dh, sl, c: _place_scan(
             capacity,
             used0,
             a,
@@ -234,6 +240,7 @@ def place_batch_kernel(
             sw,
             hs,
             dh,
+            sl,
             algorithm_spread,
             c,
             max_steps,
@@ -252,6 +259,7 @@ def place_batch_kernel(
         spread_weights,
         has_spreads,
         distinct_hosts,
+        slot_caps,
         counts,
     )
 
@@ -351,6 +359,14 @@ class PlacementKernel:
             ),
             has_spreads=np.array([a.has_spreads for a in asks]),
             distinct_hosts=np.array([a.distinct_hosts for a in asks]),
+            slot_caps=np.stack(
+                [
+                    a.slot_caps
+                    if a.slot_caps is not None
+                    else np.full(pn, np.inf, dtype=np.float32)
+                    for a in asks
+                ]
+            ),
             counts=np.array([a.count for a in asks], dtype=np.int32),
         )
         choices, scores, _used = place_batch_kernel(

@@ -340,6 +340,16 @@ class GenericScheduler:
                     continue
                 node_id = ct.node_ids[row]
                 metric.scores[f"{node_id}.score"] = float(score)
+                devices, dev_ok = self._assign_devices(tg, node_id)
+                if not dev_ok:
+                    # slot_caps are snapshot-scoped; a sibling group in
+                    # this same plan took the instances. Fail the
+                    # placement rather than shipping a device-less alloc
+                    # that would poison the whole node plan at apply time.
+                    n_failed += 1
+                    metric.exhausted_node(node_id, "devices")
+                    self._record_failure(tg_name, metric)
+                    continue
                 alloc = Allocation(
                     id=new_id(),
                     namespace=self.job.namespace,
@@ -355,6 +365,8 @@ class GenericScheduler:
                     client_status="pending",
                     metrics=metric,
                 )
+                if devices:
+                    alloc.allocated_devices = devices
                 if self.deployment is not None and tg_name in (
                     self.deployment.task_groups
                 ):
@@ -383,6 +395,32 @@ class GenericScheduler:
                         alloc.reschedule_tracker = RescheduleTracker(events=events)
                 self.plan.append_alloc(alloc)
 
+    def _assign_devices(self, tg, node_id):
+        """Concrete device-instance assignment for one placement, seeing
+        both snapshot allocs and allocations/evictions already in this
+        plan (scheduler/device.py; reference rank.go:388-434).
+        Returns (devices | None, ok): ok is False only when the group asks
+        for devices and the node can't satisfy them."""
+        from .device import assign_devices, collect_in_use, group_device_asks
+
+        if not group_device_asks(tg):
+            return None, True
+        node = self.snapshot.node_by_id(node_id)
+        if node is None:
+            return None, False
+        stopped = {a.id for a in self.plan.node_update.get(node_id, [])}
+        stopped |= {
+            a.id for a in self.plan.node_preemptions.get(node_id, [])
+        }
+        live = [
+            a
+            for a in self.snapshot.allocs_by_node(node_id)
+            if a.id not in stopped
+        ]
+        live.extend(self.plan.node_allocation.get(node_id, []))
+        devices = assign_devices(node, collect_in_use(live), tg)
+        return devices, devices is not None
+
     @staticmethod
     def _record_exhaustion(metric, ct, ga) -> None:
         """Count eligible nodes that lacked free capacity, per dimension
@@ -403,6 +441,18 @@ class GenericScheduler:
             if n:
                 metric.dimension_exhausted[dim] = (
                     metric.dimension_exhausted.get(dim, 0) + n
+                )
+        if ga.slot_caps is not None:
+            # eligible nodes whose device instances are the binding limit
+            # (resource dims fit but the device pool is drained)
+            dev_capped = (~exhausted) & np.isfinite(
+                ga.slot_caps[: ct.num_nodes][elig]
+            )
+            n = int(dev_capped.sum())
+            if n:
+                metric.nodes_exhausted += n
+                metric.dimension_exhausted["devices"] = (
+                    metric.dimension_exhausted.get("devices", 0) + n
                 )
 
     def _preemption_enabled(self) -> bool:
@@ -481,6 +531,21 @@ class GenericScheduler:
         )
         if pr.previous_alloc is not None:
             alloc.previous_allocation = pr.previous_alloc.id
+        tg = self.job.lookup_task_group(tg_name)
+        if tg is not None:
+            devices, dev_ok = self._assign_devices(tg, node_id)
+            if not dev_ok:
+                # victims chosen by resource distance didn't free the
+                # needed device instances — abandon this preemption
+                # rather than shipping a device-less alloc
+                for vid in victim_ids:
+                    allocs = self.plan.node_preemptions.get(node_id, [])
+                    self.plan.node_preemptions[node_id] = [
+                        a for a in allocs if a.id != vid
+                    ]
+                return False
+            if devices:
+                alloc.allocated_devices = devices
         self.plan.append_alloc(alloc)
         # keep the device-resident usage honest for subsequent fallbacks
         ct.used[row] += ga.ask - (victim_total if victim_total is not None else 0)

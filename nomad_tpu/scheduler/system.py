@@ -131,6 +131,13 @@ class SystemScheduler:
                     m.exhausted_node(node_id, "resources")
                     self._record_failure(tg.name, m)
                     continue
+                if ga.slot_caps is not None and ga.slot_caps[row] < 1:
+                    # device instances exist but are all held
+                    m = AllocMetric(nodes_evaluated=1)
+                    m.exhausted_node(node_id, "devices")
+                    self._record_failure(tg.name, m)
+                    continue
+                devices = self._assign_devices(tg, node_id)
                 metric = AllocMetric(nodes_evaluated=1)
                 metric.scores[f"{node_id}.score"] = float(finals[row])
                 self.plan.append_alloc(
@@ -148,6 +155,7 @@ class SystemScheduler:
                         desired_status=ALLOC_DESIRED_RUN,
                         client_status="pending",
                         metrics=metric,
+                        allocated_devices=devices or [],
                     )
                 )
             # stop allocs on nodes no longer eligible (e.g. constraint
@@ -164,6 +172,25 @@ class SystemScheduler:
                     self.plan.append_stopped_alloc(a, REASON_ALLOC_NOT_NEEDED)
 
         return self._submit()
+
+    def _assign_devices(self, tg, node_id):
+        """Concrete device instances for a system placement, seeing both
+        snapshot allocs and in-plan changes (scheduler/device.py)."""
+        from .device import assign_devices, collect_in_use, group_device_asks
+
+        if not group_device_asks(tg):
+            return None
+        node = self.snapshot.node_by_id(node_id)
+        if node is None:
+            return None
+        stopped = {a.id for a in self.plan.node_update.get(node_id, [])}
+        live = [
+            a
+            for a in self.snapshot.allocs_by_node(node_id)
+            if a.id not in stopped
+        ]
+        live.extend(self.plan.node_allocation.get(node_id, []))
+        return assign_devices(node, collect_in_use(live), tg)
 
     def _record_failure(self, tg_name: str, metric: AllocMetric) -> None:
         existing = self.failed_tg_allocs.get(tg_name)

@@ -110,6 +110,9 @@ class Allocation:
     # Concrete port/bandwidth assignments made by the plan applier's
     # NetworkIndex (list of structs.network.AllocatedNetwork).
     allocated_networks: list = field(default_factory=list)
+    # Concrete device instances assigned by the scheduler's device
+    # allocator (list of resources.AllocatedDeviceResource).
+    allocated_devices: list = field(default_factory=list)
     desired_status: str = ALLOC_DESIRED_RUN
     desired_description: str = ""
     desired_transition: DesiredTransition = field(default_factory=DesiredTransition)
@@ -136,14 +139,28 @@ class Allocation:
         return self.resources
 
     def device_asks(self) -> dict[str, int]:
-        """device id → requested instance count, from the attached job."""
+        """device id → requested instance count. Prefers the concrete
+        assignment made at placement (full vendor/type/name ids); falls
+        back to the attached job's asks (possibly partial ids)."""
+        if self.allocated_devices:
+            out: dict[str, int] = {}
+            for ad in self.allocated_devices:
+                out[ad.id()] = out.get(ad.id(), 0) + len(ad.device_ids)
+            return out
         tg = self.job.lookup_task_group(self.task_group) if self.job else None
         if tg is None:
             return {}
-        out: dict[str, int] = {}
+        out = {}
         for t in tg.tasks:
             for d in t.resources.devices:
                 out[d.name] = out.get(d.name, 0) + d.count
+        return out
+
+    def device_instance_ids(self) -> dict[str, set]:
+        """device full-id → concrete instance ids held by this alloc."""
+        out: dict[str, set] = {}
+        for ad in self.allocated_devices:
+            out.setdefault(ad.id(), set()).update(ad.device_ids)
         return out
 
     def terminal_status(self) -> bool:

@@ -129,6 +129,20 @@ class NodeDeviceResource:
 
 
 @dataclass(slots=True)
+class AllocatedDeviceResource:
+    """Concrete device instances assigned to an allocation.
+    Reference: structs.AllocatedDeviceResource (nomad/structs/structs.go)."""
+
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+    device_ids: list[str] = field(default_factory=list)
+
+    def id(self) -> str:
+        return f"{self.vendor}/{self.type}/{self.name}"
+
+
+@dataclass(slots=True)
 class NodeResources:
     """A node's fingerprinted capacity. Reference: structs.NodeResources."""
 
