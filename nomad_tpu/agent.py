@@ -23,13 +23,17 @@ class DevAgent:
         num_workers: int = 2,
         heartbeat_ttl: float = 5.0,
         node=None,
+        host_volumes: Optional[dict] = None,
     ):
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="nomad-tpu-dev-")
         self.server = Server(
             ServerConfig(num_workers=num_workers, heartbeat_ttl=heartbeat_ttl)
         )
         self.client = Client(
-            rpc=self.server.client_rpc(), data_dir=self.data_dir, node=node
+            rpc=self.server.client_rpc(),
+            data_dir=self.data_dir,
+            node=node,
+            host_volumes=host_volumes,
         )
 
     def start(self) -> None:

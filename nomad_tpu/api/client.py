@@ -92,6 +92,10 @@ class NomadClient:
     def agent(self) -> "Agent":
         return Agent(self)
 
+    @property
+    def volumes(self) -> "Volumes":
+        return Volumes(self)
+
 
 class Jobs:
     def __init__(self, c: NomadClient):
@@ -221,6 +225,31 @@ class Operator:
 
     def set_scheduler_config(self, **kwargs):
         return self.c.post("/v1/operator/scheduler/configuration", kwargs)
+
+
+class Volumes:
+    """CSI volumes (api/csi.go analog)."""
+
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def list(self):
+        return self.c.get("/v1/volumes")
+
+    def info(self, volume_id: str):
+        return self.c.get(f"/v1/volume/csi/{volume_id}")
+
+    def register(self, volume_dict: dict):
+        return self.c.post(
+            f"/v1/volume/csi/{volume_dict['id']}", volume_dict
+        )
+
+    def deregister(self, volume_id: str, force: bool = False):
+        params = {"force": "true"} if force else {}
+        return self.c.delete(f"/v1/volume/csi/{volume_id}", **params)
+
+    def plugins(self):
+        return self.c.get("/v1/plugins")
 
 
 class Agent:

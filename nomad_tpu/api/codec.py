@@ -32,10 +32,19 @@ from ..structs.job import (
     UpdateStrategy,
 )
 from ..structs.resources import (
+    AllocatedDeviceResource,
     NetworkResource,
+    NodeDeviceInstance,
+    NodeDeviceResource,
     NodeReservedResources,
     NodeResources,
     RequestedDevice,
+)
+from ..structs.volumes import (
+    CSINodeInfo,
+    ClientHostVolumeConfig,
+    VolumeMount,
+    VolumeRequest,
 )
 
 
@@ -100,18 +109,41 @@ _NESTED_LISTS = {
     "task_groups": TaskGroup,
     "networks": NetworkResource,
     "devices": RequestedDevice,
+    "volume_mounts": VolumeMount,
+    "allocated_devices": AllocatedDeviceResource,
+    "instances": NodeDeviceInstance,
+}
+_NESTED_DICTS = {
+    "volumes": VolumeRequest,
+    "host_volumes": ClientHostVolumeConfig,
+    "csi_node_plugins": CSINodeInfo,
 }
 
 
 def _decode_field(ftype, name, val):
     if name in _NESTED and isinstance(val, dict):
         return _decode_into(_NESTED[name], val)
+    if name in _NESTED_DICTS and isinstance(val, dict):
+        return {
+            k: _decode_into(_NESTED_DICTS[name], v) if isinstance(v, dict) else v
+            for k, v in val.items()
+        }
     if name in _NESTED_LISTS and isinstance(val, list):
         return [
-            _decode_into(_NESTED_LISTS[name], v) if isinstance(v, dict) else v
+            _decode_dev(v)
+            if name == "devices" and isinstance(v, dict) and "instances" in v
+            else _decode_into(_NESTED_LISTS[name], v)
+            if isinstance(v, dict)
+            else v
             for v in val
         ]
     return val
+
+
+def _decode_dev(v: dict):
+    """Node device groups (with instances) vs task device asks share the
+    field name ``devices``; disambiguate by shape."""
+    return _decode_into(NodeDeviceResource, v)
 
 
 def decode_job(data: dict) -> Job:

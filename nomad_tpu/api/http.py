@@ -24,7 +24,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..structs import Evaluation, new_id
 from ..structs.job import JOB_DEFAULT_PRIORITY
-from .codec import decode_job, encode
+from .codec import _decode_into, decode_job, encode
 
 
 class APIError(Exception):
@@ -98,6 +98,12 @@ class HTTPAgent:
                 re.compile(r"^/v1/deployment/(?P<deployment_id>[^/]+)$"),
                 self.handle_deployment,
             ),
+            (re.compile(r"^/v1/volumes$"), self.handle_volumes),
+            (
+                re.compile(r"^/v1/volume/csi/(?P<volume_id>[^/]+)$"),
+                self.handle_volume,
+            ),
+            (re.compile(r"^/v1/plugins$"), self.handle_plugins),
             (re.compile(r"^/v1/allocations$"), self.handle_allocs),
             (
                 re.compile(r"^/v1/allocation/(?P<alloc_id>[^/]+)$"),
@@ -336,7 +342,10 @@ class HTTPAgent:
             if not job.task_groups:
                 raise APIError(400, "job needs at least one task group")
             job.priority = job.priority or JOB_DEFAULT_PRIORITY
-            ev = self.server.register_job(job)
+            try:
+                ev = self.server.register_job(job)
+            except ValueError as e:  # JobValidationError
+                raise APIError(400, str(e)) from None
             return {"eval_id": ev.id, "job_modify_index": job.modify_index}
         raise APIError(405, f"method {method} not allowed")
 
@@ -470,6 +479,83 @@ class HTTPAgent:
         if not ok:
             raise APIError(400, "deployment is not active")
         return {"failed": True}
+
+    def handle_volumes(self, method, body, query):
+        """GET /v1/volumes — CSI volume stubs (csi_endpoint.go List)."""
+        self._enforce_ns(query, "csi-list-volume")
+        visible = self._ns_filter(query, "csi-list-volume")
+        self._maybe_block(query)
+        return [
+            {
+                "id": v.id,
+                "namespace": v.namespace,
+                "name": v.name,
+                "plugin_id": v.plugin_id,
+                "access_mode": v.access_mode,
+                "attachment_mode": v.attachment_mode,
+                "schedulable": v.schedulable,
+                "claims_read": len(v.read_claims),
+                "claims_write": len(v.write_claims),
+                "modify_index": v.modify_index,
+            }
+            for v in self.server.store.csi_volumes()
+            if visible(v.namespace)
+        ]
+
+    def handle_volume(self, method, body, query, volume_id):
+        """GET/PUT/DELETE /v1/volume/csi/:id (csi_endpoint.go)."""
+        from ..structs.volumes import CSIVolume
+
+        if method == "GET":
+            self._enforce_ns(query, "csi-read-volume")
+            vol = self.server.store.csi_volume_by_id(volume_id)
+            if vol is None:
+                raise APIError(404, f"volume not found: {volume_id}")
+            self._enforce_obj_ns(query, vol.namespace, "csi-read-volume")
+            return encode(vol)
+        if method == "PUT" or method == "POST":
+            vol = _decode_into(CSIVolume, body or {})
+            if vol.id and vol.id != volume_id:
+                raise APIError(
+                    400, f"volume id {vol.id!r} does not match URL {volume_id!r}"
+                )
+            vol.id = vol.id or volume_id
+            # enforce against the volume's own namespace (cross-namespace
+            # writes must not ride the query-param default)
+            self._enforce_obj_ns(query, vol.namespace, "csi-write-volume")
+            existing = self.server.store.csi_volume_by_id(vol.id)
+            if existing is not None:
+                self._enforce_obj_ns(
+                    query, existing.namespace, "csi-write-volume"
+                )
+            self.server.register_csi_volume(vol)
+            return {"index": self.server.store.latest_index}
+        if method == "DELETE":
+            existing = self.server.store.csi_volume_by_id(volume_id)
+            if existing is None:
+                raise APIError(404, f"volume not found: {volume_id}")
+            self._enforce_obj_ns(query, existing.namespace, "csi-write-volume")
+            force = query.get("force", "") in ("true", "1")
+            try:
+                self.server.deregister_csi_volume(volume_id, force=force)
+            except KeyError as e:
+                raise APIError(404, str(e)) from None
+            except ValueError as e:
+                raise APIError(409, str(e)) from None
+            return {"index": self.server.store.latest_index}
+        raise APIError(405, "method not allowed")
+
+    def handle_plugins(self, method, body, query):
+        """GET /v1/plugins — derived CSI plugin health."""
+        self._enforce(query, "plugin_list")
+        return [
+            {
+                "id": p.id,
+                "nodes_healthy": p.nodes_healthy,
+                "controllers_healthy": p.controllers_healthy,
+            }
+            for p in self.server.store.csi_plugins().values()
+        ]
 
     def handle_nodes(self, method, body, query):
         self._enforce(query, "node_read")

@@ -66,6 +66,60 @@ def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
     return True, ""
 
 
+def _csi_claims_ok(snapshot, allocs, claimed: dict) -> bool:
+    """Optimistic CSI re-verify: would every placed alloc's volume claim
+    still succeed against current claim state? ``claimed`` accumulates
+    in-plan claims (readers and writers) so two placements in one plan
+    can't jointly exceed a volume's access mode — the claim analog of
+    evaluateNodePlan's AllocsFit re-check."""
+    from ..structs.volumes import (
+        ACCESS_MODE_MULTI_NODE_MULTI_WRITER,
+        ACCESS_MODE_SINGLE_NODE_READER,
+        ACCESS_MODE_SINGLE_NODE_WRITER,
+    )
+
+    for a in allocs:
+        if a.job is None or a.client_status != "pending":
+            continue
+        tg = a.job.lookup_task_group(a.task_group)
+        if tg is None or not getattr(tg, "volumes", None):
+            continue
+        for req in tg.volumes.values():
+            if req.type != "csi":
+                continue
+            vid = req.source
+            if req.per_alloc:
+                per = f"{req.source}[{a.index()}]"
+                if snapshot.csi_volume_by_id(per) is not None:
+                    vid = per
+            vol = snapshot.csi_volume_by_id(vid)
+            if vol is None:
+                return False
+            if not vol.claimable(req.read_only):
+                return False
+            readers, writers = claimed.get(vid, (0, 0))
+            single_node = vol.access_mode in (
+                ACCESS_MODE_SINGLE_NODE_READER,
+                ACCESS_MODE_SINGLE_NODE_WRITER,
+            )
+            if req.read_only:
+                # single-node modes admit one claimant total
+                if single_node and (
+                    readers + writers + len(vol.read_claims)
+                    + len(vol.write_claims)
+                ) >= 1:
+                    return False
+                claimed[vid] = (readers + 1, writers)
+            else:
+                if vol.access_mode != ACCESS_MODE_MULTI_NODE_MULTI_WRITER and (
+                    writers + len(vol.write_claims) >= 1
+                    or (single_node and readers + len(vol.read_claims) >= 1)
+                ):
+                    return False
+                claimed[vid] = (readers, writers + 1)
+    return True
+
+
 def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     """Per-node verify + partial commit (plan_apply.go:400-596): nodes that
     fail verification are dropped from the result; when anything is
@@ -75,10 +129,15 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     touched = set(plan.node_allocation) | set(plan.node_update) | set(
         plan.node_preemptions
     )
+    claimed: dict[str, tuple[int, int]] = {}  # vid → (readers, writers)
     for node_id in sorted(touched):
         has_new = node_id in plan.node_allocation
         if has_new:
             ok, reason = evaluate_node_plan(snapshot, plan, node_id)
+            if ok and not _csi_claims_ok(
+                snapshot, plan.node_allocation[node_id], claimed
+            ):
+                ok = False
             if not ok:
                 rejected.append(node_id)
                 # stops/preemptions still commit (they only free capacity)

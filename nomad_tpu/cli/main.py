@@ -263,6 +263,60 @@ def cmd_eval_status(args) -> int:
     return 0
 
 
+def cmd_volume_status(args) -> int:
+    c = _client(args)
+    if getattr(args, "volume_id", None):
+        try:
+            v = c.volumes.info(args.volume_id)
+        except APIException as e:
+            return _fail(str(e))
+        print(json.dumps(v, indent=2, default=str))
+        return 0
+    vols = c.volumes.list()
+    print(f"{'ID':<20} {'Plugin':<12} {'Access Mode':<26} {'Schedulable':<12} Claims(R/W)")
+    for v in vols:
+        print(
+            f"{v['id'][:18]:<20} {v['plugin_id'][:10]:<12} "
+            f"{v['access_mode']:<26} {str(v['schedulable']):<12} "
+            f"{v['claims_read']}/{v['claims_write']}"
+        )
+    return 0
+
+
+def cmd_volume_register(args) -> int:
+    c = _client(args)
+    with open(args.file) as f:
+        vol = json.load(f)
+    try:
+        c.volumes.register(vol)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"Volume {vol['id']!r} registered")
+    return 0
+
+
+def cmd_volume_deregister(args) -> int:
+    c = _client(args)
+    try:
+        c.volumes.deregister(args.volume_id, force=args.force)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"Volume {args.volume_id!r} deregistered")
+    return 0
+
+
+def cmd_plugin_status(args) -> int:
+    c = _client(args)
+    plugins = c.volumes.plugins()
+    print(f"{'ID':<20} {'Healthy Nodes':<14} Healthy Controllers")
+    for p in plugins:
+        print(
+            f"{p['id'][:18]:<20} {p['nodes_healthy']:<14} "
+            f"{p['controllers_healthy']}"
+        )
+    return 0
+
+
 def cmd_deployment_list(args) -> int:
     c = _client(args)
     deployments = c.deployments.list()
@@ -409,6 +463,26 @@ def build_parser() -> argparse.ArgumentParser:
     dfail = dep.add_parser("fail")
     dfail.add_argument("deployment_id")
     dfail.set_defaults(fn=cmd_deployment_fail)
+
+    vol = sub.add_parser("volume", help="volume commands").add_subparsers(
+        dest="sub", required=True
+    )
+    vstatus = vol.add_parser("status")
+    vstatus.add_argument("volume_id", nargs="?")
+    vstatus.set_defaults(fn=cmd_volume_status)
+    vreg = vol.add_parser("register")
+    vreg.add_argument("file", help="volume spec JSON file")
+    vreg.set_defaults(fn=cmd_volume_register)
+    vdereg = vol.add_parser("deregister")
+    vdereg.add_argument("volume_id")
+    vdereg.add_argument("-force", action="store_true")
+    vdereg.set_defaults(fn=cmd_volume_deregister)
+
+    plugin = sub.add_parser("plugin", help="plugin commands").add_subparsers(
+        dest="sub", required=True
+    )
+    pstatus = plugin.add_parser("status")
+    pstatus.set_defaults(fn=cmd_plugin_status)
 
     op = sub.add_parser("operator", help="operator commands").add_subparsers(
         dest="sub", required=True

@@ -51,11 +51,17 @@ class Client:
         data_dir: str,
         node: Optional[Node] = None,
         heartbeat_interval: Optional[float] = None,
+        host_volumes: Optional[dict] = None,
     ):
         self.rpc = rpc
         self.data_dir = data_dir
         self.drivers = builtin_drivers()
         self.node = fingerprint_node(node, data_dir=data_dir, drivers=self.drivers)
+        if host_volumes:
+            # client config host_volume blocks surface on the node for the
+            # HostVolumeChecker (structs.ClientHostVolumeConfig)
+            self.node.host_volumes.update(host_volumes)
+            self.node.compute_class()
         self.heartbeat_interval = heartbeat_interval
         self.runners: dict[str, AllocRunner] = {}
         self._pending_updates: dict[str, Allocation] = {}

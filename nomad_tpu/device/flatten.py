@@ -171,7 +171,7 @@ class GroupAsk:
 
 
 def _eligibility_for_group(
-    ct: ClusterTensors, nodes_sorted, job: Job, tg: TaskGroup
+    ct: ClusterTensors, nodes_sorted, job: Job, tg: TaskGroup, snap=None
 ) -> tuple[np.ndarray, dict]:
     """ready ∧ datacenter ∧ hard constraints, with per-class memoization.
 
@@ -200,6 +200,18 @@ def _eligibility_for_group(
     escaped = any(
         "unique." in c.l_target or "unique." in c.r_target for c in constraints
     )
+    # volume feasibility is per-node: host volumes are node config and CSI
+    # claims are counted cluster state (HostVolumeChecker/CSIVolumeChecker,
+    # feasible.go:132-339)
+    volumes = getattr(tg, "volumes", None) or {}
+    if volumes:
+        from ..scheduler.feasible import (  # deferred: circular at init
+            FILTER_HOST_VOLUMES,
+            check_csi_volumes,
+            check_host_volumes,
+        )
+
+        escaped = True
     if escaped or not constraints and not drivers:
         rows = range(ct.num_nodes)
         per_class = False
@@ -216,6 +228,15 @@ def _eligibility_for_group(
                 ok_rows[j] = False
                 reason_rows.setdefault(f"missing drivers: {d}", []).append(j)
                 break
+        if ok_rows[j] and volumes:
+            if not check_host_volumes(node, volumes):
+                ok_rows[j] = False
+                reason_rows.setdefault(FILTER_HOST_VOLUMES, []).append(j)
+            else:
+                csi_ok, reason = check_csi_volumes(snap, node, volumes)
+                if not csi_ok:
+                    ok_rows[j] = False
+                    reason_rows.setdefault(reason, []).append(j)
         if ok_rows[j]:
             for c in constraints:
                 if c.operand in ("distinct_hosts", "distinct_property"):
@@ -402,7 +423,9 @@ def flatten_group_ask(
         dtype=np.float32,
     )
 
-    eligible, filter_stats = _eligibility_for_group(ct, nodes_sorted, job, tg)
+    eligible, filter_stats = _eligibility_for_group(
+        ct, nodes_sorted, job, tg, snap
+    )
 
     job_counts = np.zeros(ct.padded_n, dtype=np.int32)
     if snap is not None:

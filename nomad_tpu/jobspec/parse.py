@@ -276,6 +276,17 @@ def _parse_task(block: hcl.Block, ctx: hcl.EvalContext) -> Task:
         la = _attrs(lc.body, ctx)
         t.lifecycle_hook = str(la.get("hook", ""))
         t.lifecycle_sidecar = bool(la.get("sidecar", False))
+    for vm in b.blocks_of("volume_mount"):
+        from ..structs.volumes import VolumeMount
+
+        va = _attrs(vm.body, ctx)
+        t.volume_mounts.append(
+            VolumeMount(
+                volume=str(va.get("volume", "")),
+                destination=str(va.get("destination", "")),
+                read_only=bool(va.get("read_only", False)),
+            )
+        )
     for ab in b.blocks_of("artifact"):
         t.artifacts.append(_attrs(ab.body, ctx))
     for tb in b.blocks_of("template"):
@@ -388,6 +399,21 @@ def _parse_group(block: hcl.Block, ctx: hcl.EvalContext, job: Job) -> TaskGroup:
             size_mb=int(ea.get("size", 300)),
             sticky=bool(ea.get("sticky", False)),
             migrate=bool(ea.get("migrate", False)),
+        )
+    for vb in b.blocks_of("volume"):
+        from ..structs.volumes import VolumeRequest
+
+        if not vb.labels:
+            raise JobspecError("volume block requires a name label")
+        va = _attrs(vb.body, ctx)
+        tg.volumes[vb.labels[0]] = VolumeRequest(
+            name=vb.labels[0],
+            type=str(va.get("type", "host")),
+            source=str(va.get("source", "")),
+            read_only=bool(va.get("read_only", False)),
+            per_alloc=bool(va.get("per_alloc", False)),
+            access_mode=str(va.get("access_mode", "")),
+            attachment_mode=str(va.get("attachment_mode", "")),
         )
     for nb in b.blocks_of("network"):
         tg.networks.append(_parse_network(nb.body, ctx))

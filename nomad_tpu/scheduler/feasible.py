@@ -156,3 +156,63 @@ def check_constraint(node: Node, c: Constraint) -> bool:
             return False
         rval = resolved if resolved is not None else ""
     return check_constraint_values(c.operand, lval, rval)
+
+
+# -- volume feasibility -------------------------------------------------------
+
+FILTER_HOST_VOLUMES = "missing compatible host volumes"
+FILTER_CSI_PLUGIN = "CSI plugin is missing or unhealthy on node"
+FILTER_CSI_VOLUME = "CSI volume has exhausted its available writer claims"
+FILTER_CSI_NOT_FOUND = "CSI volume not found"
+
+
+def check_host_volumes(node: Node, volumes: dict) -> bool:
+    """HostVolumeChecker (scheduler/feasible.go:132-207): every requested
+    host volume must exist on the node; a writable request can't be
+    satisfied by a read-only host volume."""
+    for req in volumes.values():
+        if req.type not in ("", "host"):
+            continue
+        hv = node.host_volumes.get(req.source)
+        if hv is None:
+            return False
+        if getattr(hv, "read_only", False) and not req.read_only:
+            return False
+    return True
+
+
+def check_csi_volumes(snapshot, node: Node, volumes: dict) -> tuple[bool, str]:
+    """CSIVolumeChecker (scheduler/feasible.go:209-339): the volume must
+    exist, be schedulable, have claim capacity for the requested mode, and
+    the node must run a healthy node-plugin instance for its plugin (with
+    per-node volume-count budget). ``per_alloc`` requests check the
+    family's first index (claims are per-source at apply time).
+    """
+    csi_reqs = [r for r in volumes.values() if r.type == "csi"]
+    if not csi_reqs:
+        return True, ""
+    # seed the per-node budget with volumes already attached to this node
+    # (CSIVolumeChecker counts existing claims on the node)
+    mounted = 0
+    if snapshot is not None:
+        for v in snapshot.csi_volumes():
+            if node.id in v.read_claims.values() or node.id in (
+                v.write_claims.values()
+            ):
+                mounted += 1
+    for req in csi_reqs:
+        source = f"{req.source}[0]" if req.per_alloc else req.source
+        vol = snapshot.csi_volume_by_id(source) if snapshot else None
+        if vol is None and req.per_alloc:
+            vol = snapshot.csi_volume_by_id(req.source) if snapshot else None
+        if vol is None:
+            return False, FILTER_CSI_NOT_FOUND
+        plugin = node.csi_node_plugins.get(vol.plugin_id)
+        if plugin is None or not plugin.healthy:
+            return False, FILTER_CSI_PLUGIN
+        mounted += 1
+        if plugin.max_volumes and mounted > plugin.max_volumes:
+            return False, FILTER_CSI_PLUGIN
+        if not vol.claimable(req.read_only):
+            return False, FILTER_CSI_VOLUME
+    return True, ""

@@ -35,6 +35,7 @@ from ..structs import (
     TRIGGER_NODE_UPDATE,
     new_id,
 )
+from ..structs.job import validate_job
 from ..structs.evaluation import (
     EVAL_STATUS_COMPLETE,
     TRIGGER_JOB_DEREGISTER,
@@ -89,6 +90,9 @@ class Server:
         )
         self.periodic = PeriodicDispatch(self)
         self.core_gc = CoreScheduler(self)
+        from .volume_watcher import VolumeWatcher
+
+        self.volume_watcher = VolumeWatcher(self)
         self.events = StreamBroker()
         from .acl import ACLService
 
@@ -133,6 +137,7 @@ class Server:
         self.periodic.restore()
         self.periodic.start()
         self.core_gc.start()
+        self.volume_watcher.start()
         self._restore_evals()
         for i in range(self.config.num_workers):
             w = Worker(self, worker_id=i)
@@ -148,6 +153,7 @@ class Server:
         self.drainer.stop()
         self.periodic.stop()
         self.core_gc.stop()
+        self.volume_watcher.stop()
         self.plan_apply_loop.stop()
         self.plan_queue.set_enabled(False)
         self.eval_broker.set_enabled(False)
@@ -171,6 +177,7 @@ class Server:
     def register_job(self, job: Job) -> Evaluation:
         """Job.Register (nomad/job_endpoint.go): upsert job + create eval
         in one commit, then enqueue."""
+        validate_job(job)
         # periodic/parameterized jobs are templates: no eval until a child
         # is derived (job_endpoint.go Register skips eval creation for them)
         needs_eval = not job.is_periodic() and not job.is_parameterized()
@@ -352,6 +359,32 @@ class Server:
         return evals
 
     # -- API: client alloc updates ----------------------------------------
+    # -- CSI volumes (csi_endpoint.go Register/Deregister/Claim) -----------
+    def register_csi_volume(self, vol) -> None:
+        self._raft_apply(lambda index: self.store.upsert_csi_volume(index, vol))
+
+    def deregister_csi_volume(self, volume_id: str, force: bool = False) -> None:
+        self._raft_apply(
+            lambda index: self.store.deregister_csi_volume(
+                index, volume_id, force=force
+            )
+        )
+
+    def claim_csi_volume(
+        self, volume_id: str, alloc_id: str, node_id: str, read_only: bool
+    ) -> bool:
+        """Client-initiated claim (CSIVolume.Claim RPC) — plan apply claims
+        eagerly, so this is for external/API claimants."""
+        out: list[bool] = []
+        self._raft_apply(
+            lambda index: out.append(
+                self.store.csi_claim(
+                    index, volume_id, alloc_id, node_id, read_only
+                )
+            )
+        )
+        return bool(out and out[0])
+
     def update_allocs_from_client(self, updates: Iterable[Allocation]) -> None:
         updates = list(updates)
         self._raft_apply(

@@ -32,6 +32,7 @@ def save_snapshot(store, path: str) -> int:
         "acl_policies": dict(snap._t.acl_policies),
         "acl_tokens": dict(snap._t.acl_tokens),
         "acl_bootstrap": snap._t.indexes.get("acl_bootstrap", 0),
+        "csi_volumes": dict(snap._t.csi_volumes),
         "scheduler_config": snap._t.scheduler_config,
     }
     with open(path, "wb") as f:
@@ -74,6 +75,8 @@ def restore_snapshot(path: str):
     if payload.get("acl_bootstrap"):
         with store._lock:
             store._own("indexes")["acl_bootstrap"] = payload["acl_bootstrap"]
+    for vol in payload.get("csi_volumes", {}).values():
+        store.restore_csi_volume(vol)
     store.set_scheduler_config(index, payload["scheduler_config"])
     store._latest_index = max(store._latest_index, payload["index"])
     return store

@@ -77,6 +77,7 @@ class _Tables:
         "acl_policies",
         "acl_tokens",
         "acl_tokens_by_secret",
+        "csi_volumes",
         "indexes",
         "scheduler_config",
     )
@@ -95,6 +96,7 @@ class _Tables:
         self.acl_policies: dict[str, object] = {}
         self.acl_tokens: dict[str, object] = {}  # accessor_id → ACLToken
         self.acl_tokens_by_secret: dict[str, str] = {}  # secret → accessor
+        self.csi_volumes: dict[str, object] = {}  # volume id → CSIVolume
         self.indexes: dict[str, int] = {}
         self.scheduler_config: SchedulerConfiguration = SchedulerConfiguration()
 
@@ -112,6 +114,7 @@ class _Tables:
         "acl_policies",
         "acl_tokens",
         "acl_tokens_by_secret",
+        "csi_volumes",
         "indexes",
     )
 
@@ -221,6 +224,27 @@ class StateSnapshot:
 
     def acl_bootstrapped(self) -> bool:
         return self._t.indexes.get("acl_bootstrap", 0) > 0
+
+    # -- CSI volumes -------------------------------------------------------
+    def csi_volume_by_id(self, volume_id: str):
+        return self._t.csi_volumes.get(volume_id)
+
+    def csi_volumes(self) -> Iterable:
+        return self._t.csi_volumes.values()
+
+    def csi_plugins(self) -> dict:
+        """Derived CSI plugin aggregate health: plugin id → CSIPlugin,
+        counting healthy node-plugin instances across the node table
+        (structs.CSIPlugin is derived state in the reference too)."""
+        from ..structs.volumes import CSIPlugin
+
+        out: dict[str, CSIPlugin] = {}
+        for node in self._t.nodes.values():
+            for pid, info in node.csi_node_plugins.items():
+                p = out.setdefault(pid, CSIPlugin(id=pid))
+                if info.healthy:
+                    p.nodes_healthy += 1
+        return out
 
     # -- meta -------------------------------------------------------------
     def scheduler_config(self) -> SchedulerConfiguration:
@@ -587,6 +611,9 @@ class StateStore(StateSnapshot):
             for allocs in result.node_allocation.values():
                 updates.extend(allocs)
             self._upsert_allocs_locked(index, updates)
+            for allocs in result.node_allocation.values():
+                for a in allocs:
+                    self._csi_claim_for_alloc_locked(index, a)
             for du in result.deployment_updates:
                 self._update_deployment_status_locked(
                     index,
@@ -607,6 +634,120 @@ class StateStore(StateSnapshot):
                     d.id,
                 )
             self._bump(index, "allocs", "deployments")
+
+    # -- CSI volume writers ------------------------------------------------
+    def upsert_csi_volume(self, index: int, vol) -> None:
+        with self._lock:
+            table = self._own("csi_volumes")
+            existing = table.get(vol.id)
+            if existing is not None:
+                # re-registration must not wipe live claim state (the
+                # reference refuses spec changes on an in-use volume)
+                vol.read_claims = dict(existing.read_claims)
+                vol.write_claims = dict(existing.write_claims)
+                vol.past_claims = dict(existing.past_claims)
+                vol.create_index = existing.create_index
+            else:
+                vol.create_index = index
+            vol.modify_index = index
+            table[vol.id] = vol
+            self._bump(index, "csi_volumes")
+
+    def restore_csi_volume(self, vol) -> None:
+        """Snapshot restore: insert verbatim, preserving indexes."""
+        with self._lock:
+            self._own("csi_volumes")[vol.id] = vol
+            self._latest_index = max(self._latest_index, vol.modify_index)
+
+    def deregister_csi_volume(
+        self, index: int, volume_id: str, force: bool = False
+    ) -> None:
+        with self._lock:
+            table = self._own("csi_volumes")
+            vol = table.get(volume_id)
+            if vol is None:
+                raise KeyError(f"volume not found: {volume_id}")
+            if vol.in_use() and not force:
+                raise ValueError(f"volume in use: {volume_id}")
+            del table[volume_id]
+            self._bump(index, "csi_volumes")
+
+    def csi_claim(
+        self,
+        index: int,
+        volume_id: str,
+        alloc_id: str,
+        node_id: str,
+        read_only: bool,
+    ) -> bool:
+        with self._lock:
+            return self._csi_claim_locked(
+                index, volume_id, alloc_id, node_id, read_only
+            )
+
+    def _csi_claim_locked(
+        self, index, volume_id, alloc_id, node_id, read_only
+    ) -> bool:
+        import copy as _copy
+
+        table = self._own("csi_volumes")
+        vol = table.get(volume_id)
+        if vol is None:
+            return False
+        vol = _copy.deepcopy(vol)  # snapshots keep the old claim state
+        if not vol.claim(alloc_id, node_id, read_only):
+            return False
+        vol.modify_index = index
+        table[volume_id] = vol
+        self._bump(index, "csi_volumes")
+        return True
+
+    def _csi_claim_for_alloc_locked(self, index: int, alloc) -> None:
+        """Claim the CSI volumes a freshly-placed alloc's group requests
+        (the reference claims via the client Claim RPC at alloc start;
+        claiming at plan commit keeps claim counts correct for the very
+        next scheduling pass)."""
+        if alloc.client_status != "pending" or alloc.job is None:
+            return
+        tg = alloc.job.lookup_task_group(alloc.task_group)
+        if tg is None or not tg.volumes:
+            return
+        for req in tg.volumes.values():
+            if req.type != "csi":
+                continue
+            vid = req.source
+            if req.per_alloc:
+                per = f"{req.source}[{alloc.index()}]"
+                if per in self._t.csi_volumes:
+                    vid = per
+            if not self._csi_claim_locked(
+                index, vid, alloc.id, alloc.node_id, req.read_only
+            ):
+                # plan-apply verification should make this unreachable;
+                # an external claim racing the commit can still surface
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "csi claim failed at plan commit: volume=%s alloc=%s",
+                    vid,
+                    alloc.id,
+                )
+
+    def csi_release(self, index: int, volume_id: str, alloc_id: str) -> bool:
+        with self._lock:
+            import copy as _copy
+
+            table = self._own("csi_volumes")
+            vol = table.get(volume_id)
+            if vol is None:
+                return False
+            vol = _copy.deepcopy(vol)
+            if not vol.release(alloc_id):
+                return False
+            vol.modify_index = index
+            table[volume_id] = vol
+            self._bump(index, "csi_volumes")
+            return True
 
     def _update_deployment_status_locked(
         self, index: int, deployment_id: str, status: str, desc: str

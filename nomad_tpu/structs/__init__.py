@@ -82,5 +82,13 @@ from .evaluation import (
 )
 from .plan import DesiredUpdates, Plan, PlanAnnotations, PlanResult
 from .network import AllocatedNetwork, AllocatedPort, NetworkIndex
+from .volumes import (
+    CSINodeInfo,
+    CSIPlugin,
+    CSIVolume,
+    ClientHostVolumeConfig,
+    VolumeMount,
+    VolumeRequest,
+)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -181,6 +181,8 @@ class Task:
     artifacts: list[dict] = field(default_factory=list)
     templates: list[dict] = field(default_factory=list)
     kind: str = ""
+    # volume name → structs.volumes.VolumeMount
+    volume_mounts: list = field(default_factory=list)
 
 
 @dataclass(slots=True)
@@ -202,6 +204,8 @@ class TaskGroup:
     networks: list = field(default_factory=list)
     stop_after_client_disconnect_s: Optional[float] = None
     meta: dict[str, str] = field(default_factory=dict)
+    # volume name → structs.volumes.VolumeRequest (group volume blocks)
+    volumes: dict[str, object] = field(default_factory=dict)
 
     def combined_resources(self) -> Resources:
         """Sum of task asks + ephemeral disk, the group's placement ask."""
@@ -292,3 +296,64 @@ class Job:
 
     def namespaced_id(self) -> tuple[str, str]:
         return (self.namespace, self.id)
+
+
+class JobValidationError(ValueError):
+    pass
+
+
+def validate_job(job: Job) -> None:
+    """Admission validation — the high-value subset of structs.Job.Validate
+    + jobspec semantic checks (nomad/structs/structs.go Job.Validate,
+    TaskGroup.Validate):
+
+    - id/name/datacenters present, known type, non-negative counts
+    - unique group names, unique task names per group, groups non-empty
+    - every task volume_mount references a declared group volume
+    - a non-per_alloc single-writer CSI volume can't serve count > 1
+    """
+    if not job.id:
+        raise JobValidationError("missing job ID")
+    if not job.name:
+        raise JobValidationError("missing job name")
+    if not job.datacenters:
+        raise JobValidationError("job must specify at least one datacenter")
+    if job.type not in ("service", "batch", "system", "sysbatch"):
+        raise JobValidationError(f"invalid job type: {job.type!r}")
+    if not job.task_groups:
+        raise JobValidationError("job must have at least one task group")
+    seen_groups = set()
+    for tg in job.task_groups:
+        if tg.name in seen_groups:
+            raise JobValidationError(f"duplicate task group {tg.name!r}")
+        seen_groups.add(tg.name)
+        if tg.count < 0:
+            raise JobValidationError(f"group {tg.name!r} count must be >= 0")
+        if not tg.tasks:
+            raise JobValidationError(f"group {tg.name!r} has no tasks")
+        seen_tasks = set()
+        for t in tg.tasks:
+            if t.name in seen_tasks:
+                raise JobValidationError(
+                    f"duplicate task {t.name!r} in group {tg.name!r}"
+                )
+            seen_tasks.add(t.name)
+            for vm in t.volume_mounts:
+                if vm.volume not in tg.volumes:
+                    raise JobValidationError(
+                        f"task {t.name!r} mounts undeclared volume "
+                        f"{vm.volume!r}"
+                    )
+        for name, req in tg.volumes.items():
+            if req.type == "csi" and not req.source:
+                raise JobValidationError(
+                    f"volume {name!r} requires a source"
+                )
+            single_writer = req.type == "csi" and not req.read_only and (
+                req.access_mode in ("", "single-node-writer")
+            )
+            if single_writer and tg.count > 1 and not req.per_alloc:
+                raise JobValidationError(
+                    f"volume {name!r} is single-writer but group "
+                    f"{tg.name!r} has count {tg.count}; use per_alloc"
+                )

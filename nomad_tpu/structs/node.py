@@ -45,6 +45,10 @@ class Node:
     node_resources: NodeResources = field(default_factory=NodeResources)
     reserved: NodeReservedResources = field(default_factory=NodeReservedResources)
     drivers: dict[str, bool] = field(default_factory=dict)  # driver → healthy
+    # name → ClientHostVolumeConfig (client config host_volume blocks)
+    host_volumes: dict[str, object] = field(default_factory=dict)
+    # plugin id → CSINodeInfo (fingerprinted running node plugins)
+    csi_node_plugins: dict[str, object] = field(default_factory=dict)
     status: str = NODE_STATUS_INIT
     status_description: str = ""
     scheduling_eligibility: str = NODE_SCHED_ELIGIBLE
@@ -87,6 +91,9 @@ class Node:
         for d in sorted(self.drivers):
             if self.drivers[d]:
                 h.update(d.encode())
+        for name in sorted(self.host_volumes):
+            hv = self.host_volumes[name]
+            h.update(f"hv:{name}:{getattr(hv, 'read_only', False)}".encode())
         h.update(self.node_resources.to_vector().tobytes())
         self.computed_class = "v1:" + h.hexdigest()
 

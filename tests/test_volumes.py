@@ -1,0 +1,254 @@
+"""Volume tests — host volume + CSI feasibility, claim lifecycle, volume
+watcher release, plan-apply claim verification, jobspec parsing. Modeled
+on the reference's feasible_test.go (HostVolumeChecker/CSIVolumeChecker)
+and volumewatcher tests."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.device import flatten_cluster, flatten_group_ask
+from nomad_tpu.scheduler.feasible import (
+    FILTER_CSI_VOLUME,
+    FILTER_HOST_VOLUMES,
+    check_csi_volumes,
+    check_host_volumes,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    CSINodeInfo,
+    CSIVolume,
+    ClientHostVolumeConfig,
+    VolumeRequest,
+)
+from nomad_tpu.structs.volumes import (
+    ACCESS_MODE_MULTI_NODE_READER,
+    ACCESS_MODE_SINGLE_NODE_WRITER,
+)
+
+
+def hv_node(vols=("data",), read_only=False):
+    nd = mock.node()
+    for v in vols:
+        nd.host_volumes[v] = ClientHostVolumeConfig(
+            name=v, path=f"/srv/{v}", read_only=read_only
+        )
+    nd.compute_class()
+    return nd
+
+
+def vol_job(name="data", vtype="host", source=None, read_only=False, per_alloc=False):
+    j = mock.job()
+    j.task_groups[0].volumes[name] = VolumeRequest(
+        name=name,
+        type=vtype,
+        source=source or name,
+        read_only=read_only,
+        per_alloc=per_alloc,
+    )
+    return j
+
+
+class TestHostVolumes:
+    def test_missing_volume_infeasible(self):
+        assert not check_host_volumes(mock.node(), vol_job().task_groups[0].volumes)
+        assert check_host_volumes(hv_node(), vol_job().task_groups[0].volumes)
+
+    def test_readonly_host_volume_rejects_writer(self):
+        ro = hv_node(read_only=True)
+        writer = vol_job(read_only=False).task_groups[0].volumes
+        reader = vol_job(read_only=True).task_groups[0].volumes
+        assert not check_host_volumes(ro, writer)
+        assert check_host_volumes(ro, reader)
+
+    def test_flatten_filters_and_reports(self):
+        s = StateStore()
+        plain, withvol = mock.node(), hv_node()
+        s.upsert_node(1, plain)
+        s.upsert_node(2, withvol)
+        j = vol_job()
+        snap = s.snapshot()
+        ct = flatten_cluster(snap)
+        ga = flatten_group_ask(ct, snap, j, j.task_groups[0], 1)
+        assert ga.eligible[ct.row_of(withvol.id)]
+        assert not ga.eligible[ct.row_of(plain.id)]
+        assert ga.filter_stats["constraint_filtered"][FILTER_HOST_VOLUMES] == 1
+
+
+def csi_node(plugin="ebs"):
+    nd = mock.node()
+    nd.csi_node_plugins[plugin] = CSINodeInfo(plugin_id=plugin, healthy=True)
+    return nd
+
+
+class TestCSI:
+    def _setup(self, access_mode=ACCESS_MODE_SINGLE_NODE_WRITER):
+        s = StateStore()
+        nd = csi_node()
+        s.upsert_node(1, nd)
+        s.upsert_csi_volume(
+            2,
+            CSIVolume(id="vol1", plugin_id="ebs", access_mode=access_mode),
+        )
+        return s, nd
+
+    def test_feasible_with_plugin_and_volume(self):
+        s, nd = self._setup()
+        vols = vol_job(vtype="csi", source="vol1").task_groups[0].volumes
+        ok, _ = check_csi_volumes(s.snapshot(), nd, vols)
+        assert ok
+        # node without the plugin is infeasible
+        ok, reason = check_csi_volumes(s.snapshot(), mock.node(), vols)
+        assert not ok and "plugin" in reason
+
+    def test_missing_volume(self):
+        s, nd = self._setup()
+        vols = vol_job(vtype="csi", source="nope").task_groups[0].volumes
+        ok, reason = check_csi_volumes(s.snapshot(), nd, vols)
+        assert not ok and "not found" in reason
+
+    def test_single_writer_claim_exhaustion(self):
+        s, nd = self._setup()
+        assert s.csi_claim(3, "vol1", "alloc1", nd.id, read_only=False)
+        vols = vol_job(vtype="csi", source="vol1").task_groups[0].volumes
+        ok, reason = check_csi_volumes(s.snapshot(), nd, vols)
+        assert not ok and reason == FILTER_CSI_VOLUME
+
+    def test_multi_reader_allows_many(self):
+        s, nd = self._setup(ACCESS_MODE_MULTI_NODE_READER)
+        assert s.csi_claim(3, "vol1", "a1", nd.id, read_only=True)
+        assert s.csi_claim(4, "vol1", "a2", nd.id, read_only=True)
+        vols = (
+            vol_job(vtype="csi", source="vol1", read_only=True)
+            .task_groups[0]
+            .volumes
+        )
+        ok, _ = check_csi_volumes(s.snapshot(), nd, vols)
+        assert ok
+
+    def test_claim_snapshot_isolation(self):
+        s, nd = self._setup()
+        snap = s.snapshot()
+        s.csi_claim(3, "vol1", "alloc1", nd.id, read_only=False)
+        # the old snapshot still sees zero claims (MVCC copy-on-write)
+        assert not snap.csi_volume_by_id("vol1").write_claims
+        assert s.csi_volume_by_id("vol1").write_claims
+
+    def test_deregister_in_use_fails(self):
+        s, nd = self._setup()
+        s.csi_claim(3, "vol1", "alloc1", nd.id, read_only=False)
+        with pytest.raises(ValueError):
+            s.deregister_csi_volume(4, "vol1")
+        s.deregister_csi_volume(4, "vol1", force=True)
+        assert s.csi_volume_by_id("vol1") is None
+
+
+class TestEndToEnd:
+    def test_schedule_claims_and_watcher_releases(self):
+        """Full loop: placement claims the volume; a second job can't
+        claim it; alloc goes terminal; watcher releases; retry succeeds."""
+        from nomad_tpu.scheduler.testing import Harness
+
+        h = Harness()
+        nd = csi_node()
+        h.store.upsert_node(1, nd)
+        h.store.upsert_csi_volume(
+            2, CSIVolume(id="vol1", plugin_id="ebs")
+        )
+        j1 = vol_job(vtype="csi", source="vol1")
+        j1.task_groups[0].count = 1
+        h.store.upsert_job(h.next_index(), j1)
+        h.process(mock.eval_for(j1))
+        allocs = [a for a in h.store.allocs() if not a.terminal_status()]
+        assert len(allocs) == 1
+        vol = h.store.csi_volume_by_id("vol1")
+        assert list(vol.write_claims) == [allocs[0].id]
+
+        # competing job blocked by the write claim
+        j2 = vol_job(vtype="csi", source="vol1")
+        j2.task_groups[0].count = 1
+        h.store.upsert_job(h.next_index(), j2)
+        ev2 = mock.eval_for(j2)
+        h.process(ev2)
+        assert not [
+            a
+            for a in h.store.allocs_by_job("default", j2.id)
+            if not a.terminal_status()
+        ]
+        assert h.evals[-1].failed_tg_allocs
+
+        # alloc completes → watcher releases → retry places
+        done = allocs[0].copy_for_update()
+        done.client_status = "complete"
+        h.store.upsert_allocs(h.next_index(), [done])
+
+        class FakeServer:
+            store = h.store
+
+            def _raft_apply(self, fn):
+                fn(h.store.latest_index + 1)
+
+        from nomad_tpu.server.volume_watcher import VolumeWatcher
+
+        released = VolumeWatcher(FakeServer()).tick()
+        assert released == 1
+        assert not h.store.csi_volume_by_id("vol1").write_claims
+        h.process(mock.eval_for(j2))
+        assert [
+            a
+            for a in h.store.allocs_by_job("default", j2.id)
+            if not a.terminal_status()
+        ]
+
+    def test_plan_apply_rejects_double_claim(self):
+        """Two plans computed against the same snapshot both place a
+        single-writer volume user — the applier admits only the first
+        (optimistic concurrency on claims)."""
+        from nomad_tpu.broker.plan_apply import evaluate_plan
+        from nomad_tpu.structs import Plan
+
+        s = StateStore()
+        n1, n2 = csi_node(), csi_node()
+        s.upsert_node(1, n1)
+        s.upsert_node(2, n2)
+        s.upsert_csi_volume(3, CSIVolume(id="vol1", plugin_id="ebs"))
+        j = vol_job(vtype="csi", source="vol1")
+        a1 = mock.alloc(j, n1, client_status="pending")
+        a2 = mock.alloc(j, n2, client_status="pending")
+        plan = Plan()
+        plan.node_allocation[n1.id] = [a1]
+        plan.node_allocation[n2.id] = [a2]
+        result = evaluate_plan(s, plan)
+        committed = sum(len(v) for v in result.node_allocation.values())
+        assert committed == 1
+        assert len(result.rejected_nodes) == 1
+
+
+class TestJobspec:
+    def test_parse_volume_blocks(self):
+        from nomad_tpu.jobspec import parse_job_file as parse_job
+
+        hcl = """
+        job "db" {
+          datacenters = ["dc1"]
+          group "g" {
+            volume "data" {
+              type      = "csi"
+              source    = "vol1"
+              read_only = false
+              per_alloc = true
+            }
+            task "t" {
+              driver = "exec"
+              volume_mount {
+                volume      = "data"
+                destination = "/var/lib/db"
+              }
+            }
+          }
+        }
+        """
+        j = parse_job(hcl)
+        v = j.task_groups[0].volumes["data"]
+        assert v.type == "csi" and v.source == "vol1" and v.per_alloc
+        vm = j.task_groups[0].tasks[0].volume_mounts[0]
+        assert vm.volume == "data" and vm.destination == "/var/lib/db"
