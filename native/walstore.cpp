@@ -1,0 +1,446 @@
+// walstore — segmented append-only write-ahead log + tiny durable KV.
+//
+// The native durable-state layer of the framework: the role raft-boltdb
+// (Raft log + stable store) and BoltDB (client state, helper/boltdd) play
+// in the reference (nomad/server.go:105-109 raft wiring; client/state/).
+// The reference gets native-speed durability from C-backed Go libraries;
+// here it is a first-class C++ component bound into Python via ctypes
+// (no pybind11 in the image).
+//
+// Layout on disk (one directory per store):
+//   <dir>/00000000000000000001.seg   segment named by first index it holds
+//   <dir>/meta.kv                    atomic whole-file KV (term/vote/...)
+//
+// Record framing (little-endian, per entry):
+//   u32 crc32   — over the header bytes after crc + payload
+//   u32 len     — payload length
+//   u64 index   — monotonically increasing log index
+//   u64 term    — raft term (0 when unused)
+//   u32 type    — application record type
+//   u8  payload[len]
+//
+// Torn tails (crash mid-append) are detected by CRC/short-read on open and
+// truncated away. Suffix truncation (raft conflict resolution) and prefix
+// compaction (post-snapshot) are supported; compaction drops whole
+// segments only, mirroring segment-granular log stores.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cerrno>
+#include <string>
+#include <vector>
+#include <map>
+#include <mutex>
+#include <algorithm>
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+// ---- crc32 (IEEE, table-driven) ----
+uint32_t crc_table[256];
+struct CrcInit {
+  CrcInit() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      crc_table[i] = c;
+    }
+  }
+} crc_init_;
+
+uint32_t crc32(const uint8_t* buf, size_t len, uint32_t crc = 0) {
+  crc = ~crc;
+  for (size_t i = 0; i < len; i++) crc = crc_table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+#pragma pack(push, 1)
+struct RecHeader {
+  uint32_t crc;
+  uint32_t len;
+  uint64_t index;
+  uint64_t term;
+  uint32_t type;
+};
+#pragma pack(pop)
+static_assert(sizeof(RecHeader) == 28, "header packing");
+
+struct EntryLoc {
+  uint32_t segment;  // index into segments vector
+  uint64_t offset;   // file offset of the record header
+  uint64_t term;
+  uint32_t type;
+  uint32_t len;
+};
+
+struct Segment {
+  uint64_t first_index;
+  std::string path;
+  int fd = -1;        // open for append on the active (last) segment only
+  uint64_t size = 0;  // current byte size
+};
+
+std::string seg_name(uint64_t first_index) {
+  char buf[32];
+  snprintf(buf, sizeof(buf), "%020llu.seg", (unsigned long long)first_index);
+  return std::string(buf);
+}
+
+struct Wal {
+  std::string dir;
+  std::mutex mu;
+  std::vector<Segment> segments;
+  uint64_t first_index = 0;  // 0 = empty log
+  uint64_t last_index = 0;
+  std::vector<EntryLoc> locs;  // locs[i] = entry (first_index + i)
+  uint64_t max_segment_bytes = 16ull << 20;
+  std::map<std::string, std::string> kv;
+  std::string err;
+
+  int open();
+  int scan_segment(uint32_t seg_i);
+  int append(uint64_t index, uint64_t term, uint32_t type, const uint8_t* data,
+             uint32_t len);
+  int get(uint64_t index, uint64_t* term, uint32_t* type, uint8_t* out,
+          uint32_t cap, uint32_t* outlen);
+  int truncate_suffix(uint64_t from_index);
+  int compact_prefix(uint64_t to_index);
+  int sync();
+  int roll_segment(uint64_t next_index);
+  int load_kv();
+  int save_kv();
+  void close_all();
+};
+
+int Wal::load_kv() {
+  std::string path = dir + "/meta.kv";
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return 0;  // absent is fine
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (n < 8) { fclose(f); return 0; }
+  std::vector<uint8_t> buf(n);
+  if (fread(buf.data(), 1, n, f) != (size_t)n) { fclose(f); return 0; }
+  fclose(f);
+  uint32_t stored_crc, count;
+  memcpy(&stored_crc, buf.data(), 4);
+  memcpy(&count, buf.data() + 4, 4);
+  if (crc32(buf.data() + 4, n - 4) != stored_crc) return 0;  // corrupt: empty
+  size_t off = 8;
+  for (uint32_t i = 0; i < count; i++) {
+    if (off + 8 > (size_t)n) return 0;
+    uint32_t kl, vl;
+    memcpy(&kl, buf.data() + off, 4);
+    memcpy(&vl, buf.data() + off + 4, 4);
+    off += 8;
+    if (off + kl + vl > (size_t)n) return 0;
+    std::string k((char*)buf.data() + off, kl);
+    std::string v((char*)buf.data() + off + kl, vl);
+    off += kl + vl;
+    kv[k] = v;
+  }
+  return 0;
+}
+
+int Wal::save_kv() {
+  std::vector<uint8_t> buf(8, 0);
+  uint32_t count = kv.size();
+  memcpy(buf.data() + 4, &count, 4);
+  for (auto& [k, v] : kv) {
+    uint32_t kl = k.size(), vl = v.size();
+    size_t off = buf.size();
+    buf.resize(off + 8 + kl + vl);
+    memcpy(buf.data() + off, &kl, 4);
+    memcpy(buf.data() + off + 4, &vl, 4);
+    memcpy(buf.data() + off + 8, k.data(), kl);
+    memcpy(buf.data() + off + 8 + kl, v.data(), vl);
+  }
+  uint32_t crc = crc32(buf.data() + 4, buf.size() - 4);
+  memcpy(buf.data(), &crc, 4);
+  std::string tmp = dir + "/meta.kv.tmp", path = dir + "/meta.kv";
+  int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) { err = "open meta.kv.tmp: " + std::string(strerror(errno)); return -1; }
+  ssize_t w = write(fd, buf.data(), buf.size());
+  if (w != (ssize_t)buf.size()) { ::close(fd); err = "short kv write"; return -1; }
+  fsync(fd);
+  ::close(fd);
+  if (rename(tmp.c_str(), path.c_str()) != 0) {
+    err = "rename meta.kv: " + std::string(strerror(errno));
+    return -1;
+  }
+  return 0;
+}
+
+int Wal::scan_segment(uint32_t seg_i) {
+  Segment& seg = segments[seg_i];
+  FILE* f = fopen(seg.path.c_str(), "rb");
+  if (!f) { err = "open " + seg.path; return -1; }
+  uint64_t off = 0;
+  std::vector<uint8_t> payload;
+  for (;;) {
+    RecHeader h;
+    size_t r = fread(&h, 1, sizeof(h), f);
+    if (r == 0) break;  // clean EOF
+    if (r < sizeof(h)) break;  // torn header: truncate here
+    if (h.len > (64u << 20)) break;  // implausible: treat as corruption
+    payload.resize(sizeof(RecHeader) - 4 + h.len);
+    memcpy(payload.data(), ((uint8_t*)&h) + 4, sizeof(RecHeader) - 4);
+    if (fread(payload.data() + sizeof(RecHeader) - 4, 1, h.len, f) != h.len)
+      break;  // torn payload
+    if (crc32(payload.data(), payload.size()) != h.crc) break;  // corrupt tail
+    // Entries must be contiguous.
+    uint64_t expect = (first_index == 0) ? h.index : last_index + 1;
+    if (first_index != 0 && h.index != expect) break;
+    if (first_index == 0) first_index = h.index;
+    last_index = h.index;
+    locs.push_back(EntryLoc{seg_i, off, h.term, h.type, h.len});
+    off += sizeof(RecHeader) + h.len;
+  }
+  fclose(f);
+  seg.size = off;
+  // Truncate any torn tail so appends go to a clean boundary.
+  if (truncate(seg.path.c_str(), off) != 0) {
+    err = "truncate " + seg.path;
+    return -1;
+  }
+  return 0;
+}
+
+int Wal::open() {
+  mkdir(dir.c_str(), 0755);
+  DIR* d = opendir(dir.c_str());
+  if (!d) { err = "opendir " + dir + ": " + strerror(errno); return -1; }
+  std::vector<std::pair<uint64_t, std::string>> found;
+  struct dirent* de;
+  while ((de = readdir(d)) != nullptr) {
+    std::string name = de->d_name;
+    if (name.size() == 24 && name.substr(20) == ".seg")
+      found.push_back({strtoull(name.c_str(), nullptr, 10), dir + "/" + name});
+  }
+  closedir(d);
+  std::sort(found.begin(), found.end());
+  for (auto& [fi, path] : found)
+    segments.push_back(Segment{fi, path, -1, 0});
+  for (uint32_t i = 0; i < segments.size(); i++) {
+    if (scan_segment(i) != 0) return -1;
+    // Corruption in a non-final segment orphans later segments: drop them.
+    if (i + 1 < segments.size() &&
+        (locs.empty() || segments[i + 1].first_index != last_index + 1)) {
+      for (uint32_t j = i + 1; j < segments.size(); j++)
+        unlink(segments[j].path.c_str());
+      segments.resize(i + 1);
+      break;
+    }
+  }
+  if (!segments.empty()) {
+    Segment& tail = segments.back();
+    tail.fd = ::open(tail.path.c_str(), O_WRONLY | O_APPEND);
+    if (tail.fd < 0) { err = "open tail: " + std::string(strerror(errno)); return -1; }
+  }
+  return load_kv();
+}
+
+int Wal::roll_segment(uint64_t next_index) {
+  if (!segments.empty() && segments.back().fd >= 0) {
+    fsync(segments.back().fd);
+    ::close(segments.back().fd);
+    segments.back().fd = -1;
+  }
+  Segment seg;
+  seg.first_index = next_index;
+  seg.path = dir + "/" + seg_name(next_index);
+  seg.fd = ::open(seg.path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (seg.fd < 0) { err = "create segment: " + std::string(strerror(errno)); return -1; }
+  seg.size = 0;
+  segments.push_back(seg);
+  return 0;
+}
+
+int Wal::append(uint64_t index, uint64_t term, uint32_t type,
+                const uint8_t* data, uint32_t len) {
+  uint64_t expect = (first_index == 0) ? index : last_index + 1;
+  if (index != expect) { err = "non-contiguous append"; return -2; }
+  if (segments.empty() || segments.back().size >= max_segment_bytes)
+    if (roll_segment(index) != 0) return -1;
+  Segment& seg = segments.back();
+  RecHeader h{0, len, index, term, type};
+  std::vector<uint8_t> buf(sizeof(RecHeader) + len);
+  memcpy(buf.data() + 4, ((uint8_t*)&h) + 4, sizeof(RecHeader) - 4);
+  if (len) memcpy(buf.data() + sizeof(RecHeader), data, len);
+  h.crc = crc32(buf.data() + 4, buf.size() - 4);
+  memcpy(buf.data(), &h.crc, 4);
+  ssize_t w = write(seg.fd, buf.data(), buf.size());
+  if (w != (ssize_t)buf.size()) { err = "short append"; return -1; }
+  locs.push_back(EntryLoc{(uint32_t)(segments.size() - 1), seg.size, term, type, len});
+  seg.size += buf.size();
+  if (first_index == 0) first_index = index;
+  last_index = index;
+  return 0;
+}
+
+int Wal::get(uint64_t index, uint64_t* term, uint32_t* type, uint8_t* out,
+             uint32_t cap, uint32_t* outlen) {
+  if (first_index == 0 || index < first_index || index > last_index) return -3;
+  EntryLoc& loc = locs[index - first_index];
+  *term = loc.term;
+  *type = loc.type;
+  *outlen = loc.len;
+  if (out == nullptr) return 0;  // size query
+  if (cap < loc.len) return -4;
+  FILE* f = fopen(segments[loc.segment].path.c_str(), "rb");
+  if (!f) { err = "open segment for read"; return -1; }
+  fseek(f, loc.offset + sizeof(RecHeader), SEEK_SET);
+  size_t r = fread(out, 1, loc.len, f);
+  fclose(f);
+  if (r != loc.len) { err = "short read"; return -1; }
+  return 0;
+}
+
+int Wal::truncate_suffix(uint64_t from_index) {
+  if (first_index == 0 || from_index > last_index) return 0;
+  if (from_index <= first_index) {
+    // Everything goes.
+    close_all();
+    for (auto& s : segments) unlink(s.path.c_str());
+    segments.clear();
+    locs.clear();
+    first_index = last_index = 0;
+    return 0;
+  }
+  EntryLoc& loc = locs[from_index - first_index];
+  // Drop whole segments after the one containing from_index.
+  for (uint32_t j = loc.segment + 1; j < segments.size(); j++) {
+    if (segments[j].fd >= 0) ::close(segments[j].fd);
+    unlink(segments[j].path.c_str());
+  }
+  segments.resize(loc.segment + 1);
+  Segment& seg = segments.back();
+  if (seg.fd >= 0) { ::close(seg.fd); seg.fd = -1; }
+  if (truncate(seg.path.c_str(), loc.offset) != 0) { err = "truncate suffix"; return -1; }
+  seg.size = loc.offset;
+  seg.fd = ::open(seg.path.c_str(), O_WRONLY | O_APPEND);
+  if (seg.fd < 0) { err = "reopen after truncate"; return -1; }
+  locs.resize(from_index - first_index);
+  last_index = from_index - 1;
+  if (locs.empty()) {
+    // from_index == first_index handled above, so locs non-empty unless
+    // the whole log was in later segments; normalize to empty.
+    first_index = last_index = 0;
+  }
+  return 0;
+}
+
+int Wal::compact_prefix(uint64_t to_index) {
+  // Delete whole segments whose entries are all <= to_index.
+  if (first_index == 0) return 0;
+  uint32_t drop = 0;
+  for (uint32_t i = 0; i + 1 < segments.size(); i++) {
+    if (segments[i + 1].first_index - 1 <= to_index) drop = i + 1;
+    else break;
+  }
+  if (drop == 0) return 0;
+  uint64_t new_first = segments[drop].first_index;
+  for (uint32_t i = 0; i < drop; i++) unlink(segments[i].path.c_str());
+  segments.erase(segments.begin(), segments.begin() + drop);
+  locs.erase(locs.begin(), locs.begin() + (new_first - first_index));
+  for (auto& l : locs) l.segment -= drop;
+  first_index = new_first;
+  return 0;
+}
+
+int Wal::sync() {
+  if (!segments.empty() && segments.back().fd >= 0)
+    return fsync(segments.back().fd) == 0 ? 0 : -1;
+  return 0;
+}
+
+void Wal::close_all() {
+  for (auto& s : segments)
+    if (s.fd >= 0) { ::close(s.fd); s.fd = -1; }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* wal_open(const char* dir, uint64_t max_segment_bytes) {
+  Wal* w = new Wal();
+  w->dir = dir;
+  if (max_segment_bytes) w->max_segment_bytes = max_segment_bytes;
+  if (w->open() != 0) {
+    fprintf(stderr, "walstore: open failed: %s\n", w->err.c_str());
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+void wal_close(void* h) {
+  Wal* w = (Wal*)h;
+  w->close_all();
+  delete w;
+}
+
+uint64_t wal_first_index(void* h) { return ((Wal*)h)->first_index; }
+uint64_t wal_last_index(void* h) { return ((Wal*)h)->last_index; }
+
+int wal_append(void* h, uint64_t index, uint64_t term, uint32_t type,
+               const uint8_t* data, uint32_t len) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> g(w->mu);
+  return w->append(index, term, type, data, len);
+}
+
+int wal_get(void* h, uint64_t index, uint64_t* term, uint32_t* type,
+            uint8_t* out, uint32_t cap, uint32_t* outlen) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> g(w->mu);
+  return w->get(index, term, type, out, cap, outlen);
+}
+
+int wal_truncate_suffix(void* h, uint64_t from_index) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> g(w->mu);
+  return w->truncate_suffix(from_index);
+}
+
+int wal_compact_prefix(void* h, uint64_t to_index) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> g(w->mu);
+  return w->compact_prefix(to_index);
+}
+
+int wal_sync(void* h) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> g(w->mu);
+  return w->sync();
+}
+
+int wal_kv_set(void* h, const char* key, const uint8_t* val, uint32_t len) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> g(w->mu);
+  w->kv[key] = std::string((const char*)val, len);
+  return w->save_kv();
+}
+
+// Returns value length, or -1 if absent. Copies min(cap, len) bytes.
+int wal_kv_get(void* h, const char* key, uint8_t* out, uint32_t cap) {
+  Wal* w = (Wal*)h;
+  std::lock_guard<std::mutex> g(w->mu);
+  auto it = w->kv.find(key);
+  if (it == w->kv.end()) return -1;
+  uint32_t n = it->second.size();
+  if (out && cap) memcpy(out, it->second.data(), std::min(cap, n));
+  return (int)n;
+}
+
+const char* wal_last_error(void* h) { return ((Wal*)h)->err.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,11 @@
+"""Native runtime components (C++, bound via ctypes).
+
+The reference gets its durable-state performance from native-backed Go
+libraries — raft-boltdb for the Raft log, BoltDB for client state
+(nomad/server.go:105-109, client/state/). Here that layer is a C++
+segmented WAL + durable KV (native/walstore.cpp) compiled lazily on first
+import and bound with ctypes (pybind11 is not in the image). A pure-Python
+fallback keeps the framework importable if no toolchain is present.
+"""
+
+from .wal import WalStore, native_available  # noqa: F401
