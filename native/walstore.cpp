@@ -265,6 +265,9 @@ int Wal::roll_segment(uint64_t next_index) {
 
 int Wal::append(uint64_t index, uint64_t term, uint32_t type,
                 const uint8_t* data, uint32_t len) {
+  // Must match scan_segment's corruption heuristic: an entry the scanner
+  // would reject as implausibly large must never be durably written.
+  if (len > (64u << 20)) { err = "record exceeds 64MB limit"; return -5; }
   uint64_t expect = (first_index == 0) ? index : last_index + 1;
   if (index != expect) { err = "non-contiguous append"; return -2; }
   if (segments.empty() || segments.back().size >= max_segment_bytes)
@@ -277,7 +280,13 @@ int Wal::append(uint64_t index, uint64_t term, uint32_t type,
   h.crc = crc32(buf.data() + 4, buf.size() - 4);
   memcpy(buf.data(), &h.crc, 4);
   ssize_t w = write(seg.fd, buf.data(), buf.size());
-  if (w != (ssize_t)buf.size()) { err = "short append"; return -1; }
+  if (w != (ssize_t)buf.size()) {
+    // Roll back the partial record so a retried append lands at the
+    // offset the bookkeeping will record for it (fd is O_APPEND).
+    if (ftruncate(seg.fd, seg.size) != 0) { /* scan-on-reopen still saves us */ }
+    err = "short append";
+    return -1;
+  }
   locs.push_back(EntryLoc{(uint32_t)(segments.size() - 1), seg.size, term, type, len});
   seg.size += buf.size();
   if (first_index == 0) first_index = index;

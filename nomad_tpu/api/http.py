@@ -482,6 +482,8 @@ class HTTPAgent:
 
     def handle_volumes(self, method, body, query):
         """GET /v1/volumes — CSI volume stubs (csi_endpoint.go List)."""
+        if method != "GET":
+            raise APIError(405, "method not allowed")
         self._enforce_ns(query, "csi-list-volume")
         visible = self._ns_filter(query, "csi-list-volume")
         self._maybe_block(query)
@@ -528,7 +530,10 @@ class HTTPAgent:
                 self._enforce_obj_ns(
                     query, existing.namespace, "csi-write-volume"
                 )
-            self.server.register_csi_volume(vol)
+            try:
+                self.server.register_csi_volume(vol)
+            except ValueError as e:  # spec change on an in-use volume
+                raise APIError(409, str(e)) from None
             return {"index": self.server.store.latest_index}
         if method == "DELETE":
             existing = self.server.store.csi_volume_by_id(volume_id)
@@ -547,6 +552,8 @@ class HTTPAgent:
 
     def handle_plugins(self, method, body, query):
         """GET /v1/plugins — derived CSI plugin health."""
+        if method != "GET":
+            raise APIError(405, "method not allowed")
         self._enforce(query, "plugin_list")
         return [
             {

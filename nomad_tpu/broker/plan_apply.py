@@ -71,13 +71,18 @@ def _csi_claims_ok(snapshot, allocs, claimed: dict) -> bool:
     still succeed against current claim state? ``claimed`` accumulates
     in-plan claims (readers and writers) so two placements in one plan
     can't jointly exceed a volume's access mode — the claim analog of
-    evaluateNodePlan's AllocsFit re-check."""
+    evaluateNodePlan's AllocsFit re-check.
+
+    Claims are staged into a local copy and merged into ``claimed`` only
+    when the whole node passes; a rejected node's allocs never commit, so
+    leaking their claims would spuriously block later nodes in the plan."""
     from ..structs.volumes import (
         ACCESS_MODE_MULTI_NODE_MULTI_WRITER,
         ACCESS_MODE_SINGLE_NODE_READER,
         ACCESS_MODE_SINGLE_NODE_WRITER,
     )
 
+    staged = dict(claimed)
     for a in allocs:
         if a.job is None or a.client_status != "pending":
             continue
@@ -97,7 +102,7 @@ def _csi_claims_ok(snapshot, allocs, claimed: dict) -> bool:
                 return False
             if not vol.claimable(req.read_only):
                 return False
-            readers, writers = claimed.get(vid, (0, 0))
+            readers, writers = staged.get(vid, (0, 0))
             single_node = vol.access_mode in (
                 ACCESS_MODE_SINGLE_NODE_READER,
                 ACCESS_MODE_SINGLE_NODE_WRITER,
@@ -109,14 +114,16 @@ def _csi_claims_ok(snapshot, allocs, claimed: dict) -> bool:
                     + len(vol.write_claims)
                 ) >= 1:
                     return False
-                claimed[vid] = (readers + 1, writers)
+                staged[vid] = (readers + 1, writers)
             else:
                 if vol.access_mode != ACCESS_MODE_MULTI_NODE_MULTI_WRITER and (
                     writers + len(vol.write_claims) >= 1
                     or (single_node and readers + len(vol.read_claims) >= 1)
                 ):
                     return False
-                claimed[vid] = (readers, writers + 1)
+                staged[vid] = (readers, writers + 1)
+    claimed.clear()
+    claimed.update(staged)
     return True
 
 

@@ -287,6 +287,16 @@ def cmd_volume_register(args) -> int:
     c = _client(args)
     with open(args.file) as f:
         vol = json.load(f)
+    if not isinstance(vol, dict):
+        return _fail(f"volume spec {args.file!r} must be a JSON object")
+    # map Nomad-convention capitalized keys per-key (specs can mix cases)
+    camel = {"ID": "id", "Name": "name", "PluginID": "plugin_id",
+             "ExternalID": "external_id", "Namespace": "namespace",
+             "AccessMode": "access_mode",
+             "AttachmentMode": "attachment_mode"}
+    vol = {camel.get(k, k): v for k, v in vol.items()}
+    if not vol.get("id"):
+        return _fail(f"volume spec {args.file!r} has no 'id' field")
     try:
         c.volumes.register(vol)
     except APIException as e:

@@ -126,8 +126,6 @@ class _NativeWal:
 
     def append(self, index: int, term: int, type_: int, data: bytes) -> None:
         rc = self._lib.wal_append(self._h, index, term, type_, data, len(data))
-        if rc == -2:
-            raise WalError(f"non-contiguous append at {index}")
         if rc != 0:
             raise WalError(self._lib.wal_last_error(self._h).decode())
 
@@ -195,22 +193,26 @@ class _PyWal:
         segs = sorted(
             f for f in os.listdir(self.dir) if f.endswith(".seg") and len(f) == 24
         )
-        for name in segs:
+        for si, name in enumerate(segs):
             p = os.path.join(self.dir, name)
             good_off = 0
             with open(p, "rb") as f:
                 data = f.read()
             off = 0
+            torn = False
             while off + _REC.size <= len(data):
                 crc, ln, index, term, typ = _REC.unpack_from(data, off)
                 end = off + _REC.size + ln
-                if end > len(data):
+                if ln > (64 << 20) or end > len(data):
+                    torn = True
                     break
                 body = data[off + 4 : end]
                 if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                    torn = True
                     break
                 expect = index if self._first == 0 else self._last + 1
                 if self._first != 0 and index != expect:
+                    torn = True
                     break
                 if self._first == 0:
                     self._first = index
@@ -222,6 +224,18 @@ class _PyWal:
                 with open(p, "r+b") as f:
                     f.truncate(good_off)
             self._segments.append((int(name[:20]), p))
+            # Corruption in a non-final segment orphans everything after it:
+            # drop those segments entirely (matches the C++ store, keeping
+            # the two backends interchangeable on one directory).
+            next_first = (
+                int(segs[si + 1][:20]) if si + 1 < len(segs) else None
+            )
+            if next_first is not None and (
+                torn or self._last == 0 or next_first != self._last + 1
+            ):
+                for later in segs[si + 1 :]:
+                    os.unlink(os.path.join(self.dir, later))
+                break
         if self._segments:
             first, p = self._segments[-1]
             self._tail = open(p, "ab")
@@ -281,6 +295,8 @@ class _PyWal:
         self._segments.append((next_index, p))
 
     def append(self, index: int, term: int, type_: int, data: bytes) -> None:
+        if len(data) > (64 << 20):  # scanner rejects larger as corruption
+            raise WalError("record exceeds 64MB limit")
         expect = index if self._first == 0 else self._last + 1
         if index != expect:
             raise WalError(f"non-contiguous append at {index}")

@@ -191,15 +191,20 @@ def check_csi_volumes(snapshot, node: Node, volumes: dict) -> tuple[bool, str]:
     csi_reqs = [r for r in volumes.values() if r.type == "csi"]
     if not csi_reqs:
         return True, ""
-    # seed the per-node budget with volumes already attached to this node
-    # (CSIVolumeChecker counts existing claims on the node)
-    mounted = 0
+    # seed each plugin's per-node budget with the volumes of *that plugin*
+    # already attached to this node (CSIVolumeChecker counts existing
+    # claims per plugin, not node-wide)
+    mounted_by_plugin: dict[str, int] = {}
+    attached_here: set[str] = set()
     if snapshot is not None:
         for v in snapshot.csi_volumes():
             if node.id in v.read_claims.values() or node.id in (
                 v.write_claims.values()
             ):
-                mounted += 1
+                mounted_by_plugin[v.plugin_id] = (
+                    mounted_by_plugin.get(v.plugin_id, 0) + 1
+                )
+                attached_here.add(v.id)
     for req in csi_reqs:
         source = f"{req.source}[0]" if req.per_alloc else req.source
         vol = snapshot.csi_volume_by_id(source) if snapshot else None
@@ -210,9 +215,12 @@ def check_csi_volumes(snapshot, node: Node, volumes: dict) -> tuple[bool, str]:
         plugin = node.csi_node_plugins.get(vol.plugin_id)
         if plugin is None or not plugin.healthy:
             return False, FILTER_CSI_PLUGIN
-        mounted += 1
-        if plugin.max_volumes and mounted > plugin.max_volumes:
-            return False, FILTER_CSI_PLUGIN
+        if vol.id not in attached_here:  # already-mounted volumes are free
+            mounted = mounted_by_plugin.get(vol.plugin_id, 0) + 1
+            mounted_by_plugin[vol.plugin_id] = mounted
+            if plugin.max_volumes and mounted > plugin.max_volumes:
+                return False, FILTER_CSI_PLUGIN
+            attached_here.add(vol.id)  # one attach serves repeat requests
         if not vol.claimable(req.read_only):
             return False, FILTER_CSI_VOLUME
     return True, ""

@@ -374,15 +374,23 @@ class Server:
         self, volume_id: str, alloc_id: str, node_id: str, read_only: bool
     ) -> bool:
         """Client-initiated claim (CSIVolume.Claim RPC) — plan apply claims
-        eagerly, so this is for external/API claimants."""
+        eagerly, so this is for external/API claimants. Claims whose id is
+        not a live alloc are marked external so the volume watcher never
+        reaps them as "alloc gone"."""
         out: list[bool] = []
-        self._raft_apply(
-            lambda index: out.append(
+
+        def apply(index: int) -> None:
+            # classify under the raft lock: a plan apply inserting this
+            # alloc concurrently must not race the external check
+            external = self.store.alloc_by_id(alloc_id) is None
+            out.append(
                 self.store.csi_claim(
-                    index, volume_id, alloc_id, node_id, read_only
+                    index, volume_id, alloc_id, node_id, read_only,
+                    external=external,
                 )
             )
-        )
+
+        self._raft_apply(apply)
         return bool(out and out[0])
 
     def update_allocs_from_client(self, updates: Iterable[Allocation]) -> None:

@@ -49,6 +49,8 @@ class VolumeWatcher:
         released = 0
         for vol in list(store.csi_volumes()):
             for alloc_id in list(vol.read_claims) + list(vol.write_claims):
+                if alloc_id in vol.external_claims:
+                    continue  # released only by an explicit Unpublish/API call
                 alloc = store.alloc_by_id(alloc_id)
                 if alloc is None or alloc.terminal_status():
                     out: list[bool] = []

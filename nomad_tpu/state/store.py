@@ -641,11 +641,22 @@ class StateStore(StateSnapshot):
             table = self._own("csi_volumes")
             existing = table.get(vol.id)
             if existing is not None:
-                # re-registration must not wipe live claim state (the
-                # reference refuses spec changes on an in-use volume)
+                # the reference refuses spec changes on an in-use volume
+                # (csi_endpoint.go Register → vol.Validate + claim check)
+                if existing.in_use():
+                    for f in ("namespace", "plugin_id", "access_mode",
+                              "attachment_mode"):
+                        if getattr(vol, f) != getattr(existing, f):
+                            raise ValueError(
+                                f"volume {vol.id} is in use; cannot change "
+                                f"{f} from {getattr(existing, f)!r} to "
+                                f"{getattr(vol, f)!r}"
+                            )
+                # re-registration must not wipe live claim state
                 vol.read_claims = dict(existing.read_claims)
                 vol.write_claims = dict(existing.write_claims)
                 vol.past_claims = dict(existing.past_claims)
+                vol.external_claims = set(existing.external_claims)
                 vol.create_index = existing.create_index
             else:
                 vol.create_index = index
@@ -679,14 +690,16 @@ class StateStore(StateSnapshot):
         alloc_id: str,
         node_id: str,
         read_only: bool,
+        external: bool = False,
     ) -> bool:
         with self._lock:
             return self._csi_claim_locked(
-                index, volume_id, alloc_id, node_id, read_only
+                index, volume_id, alloc_id, node_id, read_only,
+                external=external,
             )
 
     def _csi_claim_locked(
-        self, index, volume_id, alloc_id, node_id, read_only
+        self, index, volume_id, alloc_id, node_id, read_only, external=False
     ) -> bool:
         import copy as _copy
 
@@ -697,6 +710,8 @@ class StateStore(StateSnapshot):
         vol = _copy.deepcopy(vol)  # snapshots keep the old claim state
         if not vol.claim(alloc_id, node_id, read_only):
             return False
+        if external:
+            vol.external_claims.add(alloc_id)
         vol.modify_index = index
         table[volume_id] = vol
         self._bump(index, "csi_volumes")

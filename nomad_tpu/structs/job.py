@@ -350,7 +350,8 @@ def validate_job(job: Job) -> None:
                     f"volume {name!r} requires a source"
                 )
             single_writer = req.type == "csi" and not req.read_only and (
-                req.access_mode in ("", "single-node-writer")
+                req.access_mode
+                in ("", "single-node-writer", "multi-node-single-writer")
             )
             if single_writer and tg.count > 1 and not req.per_alloc:
                 raise JobValidationError(

@@ -88,6 +88,9 @@ class CSIVolume:
     write_claims: dict[str, str] = field(default_factory=dict)
     # claims being detached by the volume watcher
     past_claims: dict[str, str] = field(default_factory=dict)
+    # claim ids registered via the Claim API by non-alloc claimants; the
+    # volume watcher must not reap these as "alloc gone"
+    external_claims: set[str] = field(default_factory=set)
     topologies: list[CSITopology] = field(default_factory=list)
     context: dict[str, str] = field(default_factory=dict)
     capacity_bytes: int = 0
@@ -130,6 +133,7 @@ class CSIVolume:
             if alloc_id in claims:
                 del claims[alloc_id]
                 found = True
+        self.external_claims.discard(alloc_id)
         return found
 
     def in_use(self) -> bool:

@@ -252,3 +252,146 @@ class TestJobspec:
         assert v.type == "csi" and v.source == "vol1" and v.per_alloc
         vm = j.task_groups[0].tasks[0].volume_mounts[0]
         assert vm.volume == "data" and vm.destination == "/var/lib/db"
+
+
+class TestReviewRegressions:
+    """Fixes from the round-1 code review of the CSI layer."""
+
+    def test_upsert_refuses_spec_change_while_in_use(self):
+        s = StateStore()
+        s.upsert_csi_volume(
+            1, CSIVolume(id="vol1", plugin_id="ebs",
+                         access_mode=ACCESS_MODE_SINGLE_NODE_WRITER)
+        )
+        s.csi_claim(2, "vol1", "a1", "n1", read_only=False)
+        with pytest.raises(ValueError, match="in use"):
+            s.upsert_csi_volume(
+                3, CSIVolume(id="vol1", plugin_id="ebs",
+                             access_mode="multi-node-multi-writer")
+            )
+        # same spec re-registered is fine and preserves claims
+        s.upsert_csi_volume(
+            4, CSIVolume(id="vol1", plugin_id="ebs",
+                         access_mode=ACCESS_MODE_SINGLE_NODE_WRITER)
+        )
+        assert s.csi_volume_by_id("vol1").write_claims == {"a1": "n1"}
+        # once released, spec changes are allowed again
+        s.csi_release(5, "vol1", "a1")
+        s.upsert_csi_volume(
+            6, CSIVolume(id="vol1", plugin_id="ebs",
+                         access_mode="multi-node-multi-writer")
+        )
+        assert (
+            s.csi_volume_by_id("vol1").access_mode == "multi-node-multi-writer"
+        )
+
+    def test_external_claim_survives_watcher(self):
+        from nomad_tpu.server.server import Server, ServerConfig
+        from nomad_tpu.server.volume_watcher import VolumeWatcher
+
+        srv = Server(ServerConfig(num_workers=0))
+        try:
+            srv.register_csi_volume(
+                CSIVolume(id="vol1", plugin_id="ebs",
+                          access_mode=ACCESS_MODE_SINGLE_NODE_WRITER)
+            )
+            assert srv.claim_csi_volume(
+                "vol1", "external-user-1", "somenode", read_only=False
+            )
+            w = VolumeWatcher(srv)
+            assert w.tick() == 0  # external claim NOT reaped
+            vol = srv.store.csi_volume_by_id("vol1")
+            assert "external-user-1" in vol.write_claims
+            # explicit release still works
+            out = []
+            srv._raft_apply(
+                lambda i: out.append(
+                    srv.store.csi_release(i, "vol1", "external-user-1")
+                )
+            )
+            assert out[0]
+            assert not srv.store.csi_volume_by_id("vol1").write_claims
+        finally:
+            srv.shutdown()
+
+    def test_mount_budget_is_per_plugin(self):
+        s = StateStore()
+        nd = csi_node("ebs")
+        nd.csi_node_plugins["efs"] = CSINodeInfo(
+            plugin_id="efs", healthy=True, max_volumes=2
+        )
+        s.upsert_node(1, nd)
+        # two ebs volumes already attached to this node
+        for i, vid in enumerate(["e1", "e2"]):
+            s.upsert_csi_volume(
+                2 + i,
+                CSIVolume(id=vid, plugin_id="ebs",
+                          access_mode="multi-node-multi-writer"),
+            )
+            assert s.csi_claim(4 + i, vid, f"a-{vid}", nd.id, read_only=False)
+        s.upsert_csi_volume(
+            6, CSIVolume(id="f1", plugin_id="efs",
+                         access_mode=ACCESS_MODE_SINGLE_NODE_WRITER)
+        )
+        # efs has zero attached volumes: its max_volumes=2 budget is open
+        vols = vol_job(vtype="csi", source="f1").task_groups[0].volumes
+        ok, reason = check_csi_volumes(s.snapshot(), nd, vols)
+        assert ok, reason
+
+    def test_already_attached_volume_not_double_counted(self):
+        s = StateStore()
+        nd = mock.node()
+        nd.csi_node_plugins["ebs"] = CSINodeInfo(
+            plugin_id="ebs", healthy=True, max_volumes=1
+        )
+        s.upsert_node(1, nd)
+        s.upsert_csi_volume(
+            2, CSIVolume(id="vol1", plugin_id="ebs",
+                         access_mode="multi-node-reader-only"),
+        )
+        assert s.csi_claim(3, "vol1", "a1", nd.id, read_only=True)
+        # requesting the same already-mounted volume must not burn budget
+        vols = (
+            vol_job(vtype="csi", source="vol1", read_only=True)
+            .task_groups[0]
+            .volumes
+        )
+        ok, reason = check_csi_volumes(s.snapshot(), nd, vols)
+        assert ok, reason
+
+    def test_phantom_claims_dont_leak_from_rejected_nodes(self):
+        from nomad_tpu.broker.plan_apply import _csi_claims_ok
+
+        s = StateStore()
+        s.upsert_csi_volume(
+            1, CSIVolume(id="vol1", plugin_id="ebs",
+                         access_mode=ACCESS_MODE_SINGLE_NODE_WRITER)
+        )
+        job = vol_job(vtype="csi", source="vol1")
+        job.task_groups[0].volumes["missing"] = VolumeRequest(
+            name="missing", type="csi", source="nope"
+        )
+        snap = s.snapshot()
+        a1 = mock.alloc(job=job)
+        a1.client_status = "pending"
+        claimed = {}
+        # node fails (second volume missing) — nothing may leak into claimed
+        assert not _csi_claims_ok(snap, [a1], claimed)
+        assert claimed == {}
+        # a later node claiming vol1 succeeds
+        ok_job = vol_job(vtype="csi", source="vol1")
+        a2 = mock.alloc(job=ok_job)
+        a2.client_status = "pending"
+        assert _csi_claims_ok(snap, [a2], claimed)
+        assert claimed == {"vol1": (0, 1)}
+
+    def test_multi_node_single_writer_validated(self):
+        from nomad_tpu.structs.job import JobValidationError, validate_job
+
+        j = vol_job(vtype="csi", source="vol1")
+        j.task_groups[0].count = 3
+        j.task_groups[0].volumes["data"].access_mode = (
+            "multi-node-single-writer"
+        )
+        with pytest.raises(JobValidationError, match="single-writer"):
+            validate_job(j)

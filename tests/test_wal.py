@@ -176,3 +176,49 @@ def test_empty_payload_and_large_payload(tmp_path, backend):
     assert w.get(1) == (0, 0, b"")
     assert w.get(2) == (0, 5, big)
     w.close()
+
+
+def test_oversized_record_rejected(tmp_path, backend):
+    w = make(tmp_path, backend)
+    with pytest.raises(WalError, match="64MB"):
+        w.append(1, 0, 0, b"x" * ((64 << 20) + 1))
+    assert w.last_index() == 0  # nothing durably written
+    w.append(1, 0, 0, b"fine")
+    w.close()
+    w2 = make(tmp_path, backend)
+    assert w2.last_index() == 1
+    w2.close()
+
+
+def test_corrupt_middle_segment_drops_orphans(tmp_path, backend):
+    # Roll several segments, then corrupt a middle one: later segments are
+    # orphaned (their entries would be non-contiguous) and must be dropped
+    # identically by both backends.
+    w = make(tmp_path, backend, max_segment_bytes=256)
+    for i in range(1, 31):
+        w.append(i, 1, 0, b"z" * 64)
+    w.close()
+    segs = sorted((tmp_path / "wal").glob("*.seg"))
+    assert len(segs) >= 3
+    mid = segs[1]
+    data = mid.read_bytes()
+    mid.write_bytes(data[:10] + b"\xff" * 10 + data[20:])
+    w2 = make(tmp_path, backend, max_segment_bytes=256)
+    last = w2.last_index()
+    first_of_mid = int(mid.name[:20])
+    assert last < first_of_mid  # scan stopped inside/before the corrupt seg
+    # orphaned later segment files are gone from disk
+    remaining = sorted((tmp_path / "wal").glob("*.seg"))
+    assert all(int(p.name[:20]) <= last or p == mid for p in remaining)
+    # and appends continue cleanly from the surviving tail
+    w2.append(last + 1, 2, 0, b"recovered")
+    assert w2.get(last + 1) == (2, 0, b"recovered")
+    w2.close()
+    # reopen under the OTHER backend: same view (format interchange)
+    other = "python" if backend == "native" else "native"
+    if other == "native" and not native_available():
+        return
+    w3 = make(tmp_path, other, max_segment_bytes=256)
+    assert w3.last_index() == last + 1
+    assert w3.get(last + 1) == (2, 0, b"recovered")
+    w3.close()
