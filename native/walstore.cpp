@@ -102,6 +102,7 @@ struct Wal {
   uint64_t max_segment_bytes = 16ull << 20;
   std::map<std::string, std::string> kv;
   std::string err;
+  bool failed = false;  // unrecoverable offset desync: refuse appends
 
   int open();
   int scan_segment(uint32_t seg_i);
@@ -265,6 +266,7 @@ int Wal::roll_segment(uint64_t next_index) {
 
 int Wal::append(uint64_t index, uint64_t term, uint32_t type,
                 const uint8_t* data, uint32_t len) {
+  if (failed) { err = "store poisoned by failed rollback"; return -6; }
   // Must match scan_segment's corruption heuristic: an entry the scanner
   // would reject as implausibly large must never be durably written.
   if (len > (64u << 20)) { err = "record exceeds 64MB limit"; return -5; }
@@ -282,9 +284,13 @@ int Wal::append(uint64_t index, uint64_t term, uint32_t type,
   ssize_t w = write(seg.fd, buf.data(), buf.size());
   if (w != (ssize_t)buf.size()) {
     // Roll back the partial record so a retried append lands at the
-    // offset the bookkeeping will record for it (fd is O_APPEND).
-    if (ftruncate(seg.fd, seg.size) != 0) { /* scan-on-reopen still saves us */ }
-    err = "short append";
+    // offset the bookkeeping will record for it (fd is O_APPEND). If the
+    // rollback itself fails, offsets and file contents have diverged for
+    // good — poison the store so no further append can record a wrong
+    // offset for an acked entry.
+    if (ftruncate(seg.fd, seg.size) != 0) failed = true;
+    err = failed ? "short append; rollback failed (store poisoned)"
+                 : "short append";
     return -1;
   }
   locs.push_back(EntryLoc{(uint32_t)(segments.size() - 1), seg.size, term, type, len});

@@ -122,7 +122,6 @@ def _csi_claims_ok(snapshot, allocs, claimed: dict) -> bool:
                 ):
                     return False
                 staged[vid] = (readers, writers + 1)
-    claimed.clear()
     claimed.update(staged)
     return True
 

@@ -118,14 +118,20 @@ class _NativeWal:
             self._lib.wal_close(self._h)
             self._h = None
 
+    def _handle(self):
+        h = self._h
+        if not h:
+            raise WalError("wal store is closed")
+        return h
+
     def first_index(self) -> int:
-        return self._lib.wal_first_index(self._h)
+        return self._lib.wal_first_index(self._handle())
 
     def last_index(self) -> int:
-        return self._lib.wal_last_index(self._h)
+        return self._lib.wal_last_index(self._handle())
 
     def append(self, index: int, term: int, type_: int, data: bytes) -> None:
-        rc = self._lib.wal_append(self._h, index, term, type_, data, len(data))
+        rc = self._lib.wal_append(self._handle(), index, term, type_, data, len(data))
         if rc != 0:
             raise WalError(self._lib.wal_last_error(self._h).decode())
 
@@ -133,38 +139,38 @@ class _NativeWal:
         term = ctypes.c_uint64()
         type_ = ctypes.c_uint32()
         outlen = ctypes.c_uint32()
-        rc = self._lib.wal_get(self._h, index, term, type_, None, 0, outlen)
+        rc = self._lib.wal_get(self._handle(), index, term, type_, None, 0, outlen)
         if rc == -3:
             raise KeyError(index)
         if rc != 0:
             raise WalError(self._lib.wal_last_error(self._h).decode())
         buf = ctypes.create_string_buffer(outlen.value)
-        rc = self._lib.wal_get(self._h, index, term, type_, buf, outlen.value, outlen)
+        rc = self._lib.wal_get(self._handle(), index, term, type_, buf, outlen.value, outlen)
         if rc != 0:
             raise WalError(self._lib.wal_last_error(self._h).decode())
         return term.value, type_.value, buf.raw[: outlen.value]
 
     def truncate_suffix(self, from_index: int) -> None:
-        if self._lib.wal_truncate_suffix(self._h, from_index) != 0:
+        if self._lib.wal_truncate_suffix(self._handle(), from_index) != 0:
             raise WalError(self._lib.wal_last_error(self._h).decode())
 
     def compact_prefix(self, to_index: int) -> None:
-        if self._lib.wal_compact_prefix(self._h, to_index) != 0:
+        if self._lib.wal_compact_prefix(self._handle(), to_index) != 0:
             raise WalError(self._lib.wal_last_error(self._h).decode())
 
     def sync(self) -> None:
-        self._lib.wal_sync(self._h)
+        self._lib.wal_sync(self._handle())
 
     def kv_set(self, key: str, value: bytes) -> None:
-        if self._lib.wal_kv_set(self._h, key.encode(), value, len(value)) != 0:
+        if self._lib.wal_kv_set(self._handle(), key.encode(), value, len(value)) != 0:
             raise WalError("kv_set failed")
 
     def kv_get(self, key: str) -> Optional[bytes]:
-        n = self._lib.wal_kv_get(self._h, key.encode(), None, 0)
+        n = self._lib.wal_kv_get(self._handle(), key.encode(), None, 0)
         if n < 0:
             return None
         buf = ctypes.create_string_buffer(n or 1)
-        self._lib.wal_kv_get(self._h, key.encode(), buf, n)
+        self._lib.wal_kv_get(self._handle(), key.encode(), buf, n)
         return buf.raw[:n]
 
 
@@ -199,20 +205,16 @@ class _PyWal:
             with open(p, "rb") as f:
                 data = f.read()
             off = 0
-            torn = False
             while off + _REC.size <= len(data):
                 crc, ln, index, term, typ = _REC.unpack_from(data, off)
                 end = off + _REC.size + ln
                 if ln > (64 << 20) or end > len(data):
-                    torn = True
                     break
                 body = data[off + 4 : end]
                 if zlib.crc32(body) & 0xFFFFFFFF != crc:
-                    torn = True
                     break
                 expect = index if self._first == 0 else self._last + 1
                 if self._first != 0 and index != expect:
-                    torn = True
                     break
                 if self._first == 0:
                     self._first = index
@@ -224,14 +226,15 @@ class _PyWal:
                 with open(p, "r+b") as f:
                     f.truncate(good_off)
             self._segments.append((int(name[:20]), p))
-            # Corruption in a non-final segment orphans everything after it:
-            # drop those segments entirely (matches the C++ store, keeping
-            # the two backends interchangeable on one directory).
+            # A later segment is orphaned only when it is NON-CONTIGUOUS
+            # with what survived (lost entries); a torn tail whose entries
+            # all parsed keeps its successors — exactly the C++ open()
+            # rule, keeping the two backends interchangeable on one dir.
             next_first = (
                 int(segs[si + 1][:20]) if si + 1 < len(segs) else None
             )
             if next_first is not None and (
-                torn or self._last == 0 or next_first != self._last + 1
+                self._last == 0 or next_first != self._last + 1
             ):
                 for later in segs[si + 1 :]:
                     os.unlink(os.path.join(self.dir, later))

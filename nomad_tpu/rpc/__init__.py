@@ -1,0 +1,19 @@
+"""Internal RPC — the framework's server↔server / client↔server transport.
+
+Reference: nomad/rpc.go (msgpack-RPC multiplexed over yamux :24-30,
+handleConn :195), helper/pool (server-to-server connection pool), and the
+streaming-RPC registry (nomad/server.go:158). Here: length-prefixed frames
+over TCP with sequence-id multiplexing (many in-flight calls per
+connection — the yamux role), thread-per-request dispatch, and streaming
+responses for logs/exec/event feeds.
+
+Payloads are pickled Python structs — the fidelity analog of the
+reference's msgpack codec on its trusted server mesh; TLS/mTLS wrapping is
+the same boundary the reference uses (tlsutil) and slots in at the socket
+layer.
+"""
+
+from .client import RPCClient, RPCError
+from .server import RPCServer
+
+__all__ = ["RPCClient", "RPCServer", "RPCError"]

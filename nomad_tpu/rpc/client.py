@@ -1,0 +1,151 @@
+"""RPC client: one multiplexed connection per remote address with a demux
+reader thread; blocking unary calls and streaming iterators.
+
+Reference: helper/pool (ConnPool — the server-to-server connection pool,
+nomad/rpc.go uses it for forwarding) and client/rpc.go (client→server
+calls with retry/rebalance on connection failure).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import socket
+import threading
+from typing import Any, Iterator, Optional
+
+from .framing import recv_frame, send_frame
+
+
+class RPCError(Exception):
+    """Error raised by the remote handler (crossed the wire)."""
+
+
+class _Conn:
+    def __init__(self, address: str, timeout: float):
+        host, port = address.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=timeout)
+        # TCP self-connect guard: dialing a free port can land on a socket
+        # whose ephemeral local port equals the target, yielding a
+        # connection to ourselves that then squats the server's port.
+        if self.sock.getsockname() == self.sock.getpeername():
+            self.sock.close()
+            raise ConnectionError(f"self-connect dialing {address}")
+        self.sock.settimeout(None)  # reader blocks; callers time out on queues
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.send_lock = threading.Lock()
+        self.pending: dict[int, queue.Queue] = {}
+        self.pending_lock = threading.Lock()
+        self.dead = threading.Event()
+        self.reader = threading.Thread(
+            target=self._read_loop, name="rpc-demux", daemon=True
+        )
+        self.reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = recv_frame(self.sock)
+                with self.pending_lock:
+                    q = self.pending.get(msg.get("seq"))
+                if q is not None:
+                    q.put(msg)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.dead.set()
+            with self.pending_lock:
+                for q in self.pending.values():
+                    q.put({"error": "connection closed"})
+                self.pending.clear()
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RPCClient:
+    def __init__(self, address: str, timeout: float = 10.0):
+        self.address = address
+        self.timeout = timeout
+        self._seq = itertools.count(1)
+        self._conn: Optional[_Conn] = None
+        self._conn_lock = threading.Lock()
+
+    def _get_conn(self) -> _Conn:
+        with self._conn_lock:
+            if self._conn is None or self._conn.dead.is_set():
+                self._conn = _Conn(self.address, self.timeout)
+            return self._conn
+
+    def close(self) -> None:
+        with self._conn_lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+    def _send(self, method: str, args: Any) -> tuple[_Conn, int, queue.Queue]:
+        conn = self._get_conn()
+        seq = next(self._seq)
+        q: queue.Queue = queue.Queue()
+        with conn.pending_lock:
+            conn.pending[seq] = q
+        try:
+            with conn.send_lock:
+                send_frame(conn.sock, {"seq": seq, "method": method, "args": args})
+        except (ConnectionError, OSError) as e:
+            with conn.pending_lock:
+                conn.pending.pop(seq, None)
+            conn.dead.set()
+            raise ConnectionError(f"rpc send to {self.address}: {e}") from e
+        return conn, seq, q
+
+    def call(self, method: str, args: Any = None,
+             timeout: Optional[float] = None) -> Any:
+        conn, seq, q = self._send(method, args)
+        try:
+            msg = q.get(timeout=timeout if timeout is not None else self.timeout)
+        except queue.Empty:
+            raise TimeoutError(f"rpc {method} to {self.address} timed out") from None
+        finally:
+            with conn.pending_lock:
+                conn.pending.pop(seq, None)
+        if "error" in msg:
+            if msg["error"] == "connection closed":
+                raise ConnectionError(f"rpc {method}: connection closed")
+            raise RPCError(msg["error"])
+        return msg.get("result")
+
+    def stream(self, method: str, args: Any = None,
+               timeout: Optional[float] = None) -> Iterator[Any]:
+        """Iterate streamed chunks until the server marks the end."""
+        conn, seq, q = self._send(method, args)
+        per_chunk = timeout if timeout is not None else self.timeout
+        try:
+            while True:
+                try:
+                    msg = q.get(timeout=per_chunk)
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"rpc stream {method} to {self.address} timed out"
+                    ) from None
+                if "error" in msg:
+                    if msg["error"] == "connection closed":
+                        raise ConnectionError(f"rpc stream {method}: closed")
+                    raise RPCError(msg["error"])
+                if not msg.get("more", False):
+                    return
+                yield msg.get("chunk")
+        finally:
+            with conn.pending_lock:
+                conn.pending.pop(seq, None)

@@ -1,0 +1,49 @@
+"""Wire framing: u32 big-endian length prefix + pickled message dict.
+
+Messages:
+  request   {"seq": int, "method": str, "args": Any}
+  response  {"seq": int, "result": Any}           (unary)
+  error     {"seq": int, "error": str}
+  chunk     {"seq": int, "chunk": Any, "more": bool}   (streaming)
+
+The 64 MB frame cap matches the WAL's record cap; anything larger is a
+protocol violation, not data.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any
+
+MAX_FRAME = 64 << 20
+_LEN = struct.Struct(">I")
+
+
+class FramingError(Exception):
+    pass
+
+
+def send_frame(sock: socket.socket, msg: dict) -> None:
+    payload = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(payload) > MAX_FRAME:
+        raise FramingError(f"frame too large: {len(payload)}")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    if n > MAX_FRAME:
+        raise FramingError(f"frame too large: {n}")
+    return pickle.loads(_recv_exact(sock, n))

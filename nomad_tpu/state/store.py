@@ -301,6 +301,14 @@ class StateStore(StateSnapshot):
             for tb in tables:
                 fn(tb, index)
 
+    def bump_index(self, index: int) -> None:
+        """Advance latest_index without touching tables — raft NOOP/barrier
+        entries consume log indexes that must stay visible to blocking
+        queries (SnapshotMinIndex semantics, worker.go:536)."""
+        with self._lock:
+            self._latest_index = max(self._latest_index, index)
+            self._cond.notify_all()
+
     def add_listener(self, fn: Callable[[str, int], None]) -> None:
         """Table-change listener (the event-broker / blocked-evals hook)."""
         with self._lock:
