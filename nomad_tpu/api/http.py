@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..server.fsm import MsgType
 from ..structs import Evaluation, new_id
 from ..structs.job import JOB_DEFAULT_PRIORITY
 from .codec import _decode_into, decode_job, encode
@@ -624,10 +625,9 @@ class HTTPAgent:
         elig = body.get("eligibility") if body else None
         if elig not in ("eligible", "ineligible"):
             raise APIError(400, "eligibility must be eligible|ineligible")
-        self.server._raft_apply(
-            lambda index: self.server.store.update_node_eligibility(
-                index, node.id, elig
-            )
+        self.server.raft_apply(
+            MsgType.NODE_ELIGIBILITY,
+            {"node_id": node.id, "eligibility": elig},
         )
         return {"eligibility": elig}
 
@@ -720,11 +720,7 @@ class HTTPAgent:
             )
             if new_cfg.scheduler_algorithm not in ("binpack", "spread"):
                 raise APIError(400, "scheduler_algorithm must be binpack|spread")
-            self.server._raft_apply(
-                lambda index: self.server.store.set_scheduler_config(
-                    index, new_cfg
-                )
-            )
+            self.server.raft_apply(MsgType.SCHED_CONFIG, {"config": new_cfg})
             return {"updated": True}
         raise APIError(405, f"method {method} not allowed")
 

@@ -199,31 +199,44 @@ def preemption_evals(store, result: PlanResult) -> list:
 
 
 class PlanApplier:
-    """Serialized apply loop state: evaluate against live store, commit via
-    upsert_plan_results, bump indexes. One instance per leader.
+    """Serialized apply loop state: evaluate against live store, commit
+    through the raft seam (applyPlan → raftApply(ApplyPlanResultsRequest),
+    plan_apply.go:204-318). One instance per leader. ``commit`` submits the
+    PLAN_RESULT FSM message and returns the committed index; when absent
+    (bare Harness tests) the result is applied to the store directly.
     ``on_evals_created`` (if set) receives preemption follow-up evals for
     broker enqueue."""
 
-    def __init__(self, store, on_evals_created=None):
+    def __init__(self, store, on_evals_created=None, commit=None):
         self.store = store
         self.on_evals_created = on_evals_created
+        self.commit = commit
         self._lock = threading.Lock()
 
     def apply(self, plan: Plan) -> PlanResult:
         with self._lock:
             result = evaluate_plan(self.store, plan)
             if not result.is_no_op() or result.deployment is not None:
-                index = self.store.latest_index + 1
-                self.store.upsert_plan_results(index, result, plan.eval_id)
-                result.alloc_index = index
-                if result.node_preemptions:
-                    evals = preemption_evals(self.store, result)
+                evals = (
+                    preemption_evals(self.store, result)
+                    if result.node_preemptions else []
+                )
+                if self.commit is not None:
+                    index = self.commit(result, plan.eval_id, evals)
+                else:
+                    index = self.store.latest_index + 1
+                    self.store.upsert_plan_results(index, result, plan.eval_id)
                     if evals:
                         self.store.upsert_evals(
                             self.store.latest_index + 1, evals
                         )
-                        if self.on_evals_created is not None:
-                            self.on_evals_created(evals)
+                result.alloc_index = index
+                if evals and self.on_evals_created is not None:
+                    # re-read post-commit: a consensus FSM applies COPIES,
+                    # so the submitted objects lack committed modify_index
+                    self.on_evals_created([
+                        self.store.eval_by_id(e.id) or e for e in evals
+                    ])
             if result.rejected_nodes:
                 result.refresh_index = self.store.latest_index
             return result

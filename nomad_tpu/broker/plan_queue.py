@@ -73,8 +73,11 @@ class PlanQueue:
 class PlanApplyLoop:
     """The leader's serialized applier thread (plan_apply.go:71-178)."""
 
-    def __init__(self, store, queue: PlanQueue, on_evals_created=None):
-        self.applier = PlanApplier(store, on_evals_created=on_evals_created)
+    def __init__(self, store, queue: PlanQueue, on_evals_created=None,
+                 commit=None):
+        self.applier = PlanApplier(
+            store, on_evals_created=on_evals_created, commit=commit
+        )
         self.queue = queue
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -142,6 +142,7 @@ class RaftNode:
 
         self._last_contact = time.monotonic()
         self._timeout = self._rand_timeout()
+        self._closed = False
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._futures: dict[int, Future] = {}
@@ -204,8 +205,10 @@ class RaftNode:
         # an in-flight RPC handler into a use-after-free of the native WAL
         with self._mu:
             self._apply_cv.notify_all()
-            self.log.sync()
-            self.log.close()
+            if not self._closed:  # shutdown is idempotent
+                self._closed = True
+                self.log.sync()
+                self.log.close()
 
     # -- helpers -----------------------------------------------------------
     def _rand_timeout(self) -> float:
@@ -269,6 +272,8 @@ class RaftNode:
         """Leader-only: append, replicate, wait for commit+apply, return
         (index, fsm_result). Raises NotLeaderError for forwarding."""
         with self._mu:
+            if self._stop.is_set():
+                raise NotLeaderError(None, None)
             if self.state != LEADER:
                 raise NotLeaderError(self.leader, self.leader_addr())
             index = self._last_log()[0] + 1
@@ -426,7 +431,9 @@ class RaftNode:
             ev.wait(timeout=self.config.heartbeat_interval)
             ev.clear()
             with self._mu:
-                if self.state != LEADER or self.term != term:
+                if self._stop.is_set() or self.state != LEADER or (
+                    self.term != term
+                ):
                     return
                 next_idx = self._next_index[peer_id]
                 first = self.log.first_index()

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
+from .fsm import MsgType
 from ..acl import (
     ACL,
     AclCache,
@@ -46,9 +47,7 @@ class ACLService:
         token = ACLToken(
             name="Bootstrap Token", type=TOKEN_TYPE_MANAGEMENT, global_=True
         )
-        self.server._raft_apply(
-            lambda index: self.server.store.bootstrap_acl_token(index, token)
-        )
+        self.server.raft_apply_checked(MsgType.ACL_BOOTSTRAP, {"token": token})
         return token
 
     # -- policies ----------------------------------------------------------
@@ -58,16 +57,12 @@ class ACLService:
             parse_policy(p.rules)  # validates; raises AclPolicyError
             if not p.name:
                 raise ValueError("policy name required")
-        self.server._raft_apply(
-            lambda index: self.server.store.upsert_acl_policies(index, policies)
-        )
+        self.server.raft_apply_checked(MsgType.ACL_POLICY_UPSERT, {"policies": policies})
         self.cache = AclCache()  # rules changed: drop compiled ACLs
 
     def delete_policies(self, names: Iterable[str]) -> None:
         names = list(names)
-        self.server._raft_apply(
-            lambda index: self.server.store.delete_acl_policies(index, names)
-        )
+        self.server.raft_apply_checked(MsgType.ACL_POLICY_DELETE, {"names": names})
         self.cache = AclCache()
 
     # -- tokens ------------------------------------------------------------
@@ -80,16 +75,12 @@ class ACLService:
             for pname in t.policies:
                 if self.server.store.acl_policy_by_name(pname) is None:
                     raise ValueError(f"policy {pname!r} does not exist")
-        self.server._raft_apply(
-            lambda index: self.server.store.upsert_acl_tokens(index, tokens)
-        )
+        self.server.raft_apply_checked(MsgType.ACL_TOKEN_UPSERT, {"tokens": tokens})
         return tokens
 
     def delete_tokens(self, accessor_ids: Iterable[str]) -> None:
         ids = list(accessor_ids)
-        self.server._raft_apply(
-            lambda index: self.server.store.delete_acl_tokens(index, ids)
-        )
+        self.server.raft_apply_checked(MsgType.ACL_TOKEN_DELETE, {"accessor_ids": ids})
 
     # -- resolution --------------------------------------------------------
     def resolve_token(self, secret_id: str) -> Optional[ACL]:

@@ -1,0 +1,219 @@
+"""ClusterServer — a consensus member serving the full server RPC surface.
+
+Reference: nomad/server.go (endpoint registry :262-289, Raft wiring
+:105-109) + nomad/rpc.go ``forward()`` (non-leader servers transparently
+forward writes to the leader) + nomad/leader.go monitorLeadership
+(establish/revoke leader services on election).
+
+Composition: Server (endpoints, broker, applier, watchers — leader-only
+services gated by raft callbacks) + RPCServer (transport) + RaftNode
+(replication). Clients and CLIs may talk to ANY server; reads answer
+locally (eventually-consistent default, like stale=true) and writes chase
+the leader.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from ..raft import NotLeaderError, RaftNode
+from ..raft.node import RaftConfig
+from ..rpc import RPCClient, RPCServer
+from ..state.snapshot import restore_snapshot, save_snapshot
+from .server import Server, ServerConfig
+
+log = logging.getLogger(__name__)
+
+# methods exposed over "Nomad." — name -> needs_leader
+_ENDPOINTS = {
+    # writes (forwarded to the leader)
+    "register_job": True,
+    "deregister_job": True,
+    "dispatch_job": True,
+    "register_node": True,
+    "update_node_status": True,
+    "update_node_drain": True,
+    "update_allocs_from_client": True,
+    "register_csi_volume": True,
+    "deregister_csi_volume": True,
+    "claim_csi_volume": True,
+}
+
+
+class ClusterServer:
+    def __init__(
+        self,
+        node_id: str,
+        peers: Dict[str, str],
+        rpc_server: RPCServer,
+        data_dir: Optional[str] = None,
+        server_config: Optional[ServerConfig] = None,
+        **raft_overrides,
+    ):
+        self.node_id = node_id
+        self.rpc = rpc_server
+        cfg = server_config or ServerConfig()
+        cfg.data_dir = None  # durability lives in the RaftNode's log
+        self.server = Server(cfg)
+        self.raft = RaftNode(
+            RaftConfig(
+                node_id=node_id, peers=dict(peers), data_dir=data_dir,
+                **raft_overrides,
+            ),
+            self.server.fsm,
+            snapshot_fn=lambda path: save_snapshot(self.server.store, path),
+            restore_fn=lambda path: self.server._install_store(
+                restore_snapshot(path)
+            ),
+            on_leader=self._on_leader,
+            on_follower=self._on_follower,
+        )
+        self.server.attach_raft(self.raft)
+        self._register_endpoints()
+        self._forward_clients: dict[str, RPCClient] = {}
+        self._fc_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.raft.start(self.rpc)
+
+    def shutdown(self) -> None:
+        if self.server._leader:
+            self.server.revoke_leadership()
+        self.raft.shutdown()
+
+    # -- leadership hooks (leader.go monitorLeadership) --------------------
+    def _on_leader(self) -> None:
+        try:
+            # barrier: ensure our FSM has caught up with every commit of
+            # prior terms before enabling schedulers (leader.go:230 Barrier)
+            self.raft.barrier(timeout=10.0)
+            self.server.establish_leadership()
+        except Exception:
+            log.exception("establish_leadership failed")
+
+    def _on_follower(self) -> None:
+        try:
+            self.server.revoke_leadership()
+        except Exception:
+            log.exception("revoke_leadership failed")
+
+    # -- RPC surface -------------------------------------------------------
+    def _register_endpoints(self) -> None:
+        for name, needs_leader in _ENDPOINTS.items():
+            self.rpc.register(f"Nomad.{name}", self._make_handler(name))
+        self.rpc.register("Nomad.heartbeat", self._handle_heartbeat)
+        self.rpc.register("Nomad.pull_allocs", self._handle_pull_allocs)
+        self.rpc.register("Nomad.leader", lambda a: {
+            "leader": self.raft.leader_id(),
+            "leader_addr": self.raft.leader_addr(),
+        })
+        self.rpc.register("Nomad.stats", lambda a: self.raft.stats())
+
+    def _make_handler(self, name: str):
+        fn = getattr(self.server, name)
+
+        def handler(args):
+            kwargs = dict(args or {})
+            hops = kwargs.pop("_hops", 0)
+            try:
+                return fn(**kwargs)
+            except NotLeaderError as e:
+                if hops >= 3:
+                    raise
+                addr = e.leader_addr or self.raft.leader_addr()
+                if not addr or addr == self.rpc.address:
+                    raise
+                kwargs["_hops"] = hops + 1
+                return self._forward(addr, f"Nomad.{name}", kwargs)
+
+        return handler
+
+    def _forward(self, addr: str, method: str, args: dict):
+        with self._fc_lock:
+            c = self._forward_clients.get(addr)
+            if c is None:
+                c = RPCClient(addr)
+                self._forward_clients[addr] = c
+        return c.call(method, args)
+
+    # client-plane handlers: heartbeats and alloc pulls are served by any
+    # server against local state (node_endpoint.go allows stale reads for
+    # GetClientAllocs); status resurrection is a write and chases the leader
+    def _handle_heartbeat(self, args):
+        node_id = args["node_id"]
+        node = self.server.store.node_by_id(node_id)
+        if node is not None and node.status == "down":
+            try:
+                self.server.update_node_status(node_id, "ready")
+            except NotLeaderError as e:
+                addr = e.leader_addr or self.raft.leader_addr()
+                if addr and addr != self.rpc.address:
+                    self._forward(
+                        addr, "Nomad.update_node_status",
+                        {"node_id": node_id, "status": "ready"},
+                    )
+        if self.server._leader:
+            return self.server.heartbeater.heartbeat(node_id)
+        return self.server.config.heartbeat_ttl
+
+    def _handle_pull_allocs(self, args):
+        allocs, index = self.server.pull_allocs(
+            args["node_id"], args.get("min_index", 0),
+            timeout=args.get("timeout", 1.0),
+        )
+        return {"allocs": allocs, "index": index}
+
+
+class RemoteClientRPC:
+    """The client agent's transport to a server cluster: mirrors
+    InProcessClientRPC over TCP with server-list failover (client/rpc.go
+    RemoteServers + rebalance-on-failure)."""
+
+    def __init__(self, servers: list[str], timeout: float = 10.0):
+        self.servers = list(servers)
+        self.timeout = timeout
+        self._clients: dict[str, RPCClient] = {}
+        self._cur = 0
+
+    def _call(self, method: str, args: dict):
+        last_err: Optional[Exception] = None
+        for attempt in range(len(self.servers)):
+            addr = self.servers[self._cur % len(self.servers)]
+            c = self._clients.get(addr)
+            if c is None:
+                c = RPCClient(addr, timeout=self.timeout)
+                self._clients[addr] = c
+            try:
+                return c.call(method, args)
+            except (ConnectionError, TimeoutError, OSError) as e:
+                last_err = e
+                self._cur += 1  # rotate to the next server
+        raise ConnectionError(
+            f"all servers unreachable for {method}: {last_err}"
+        )
+
+    def register_node(self, node) -> None:
+        self._call("Nomad.register_node", {"node": node})
+        self._call("Nomad.heartbeat", {"node_id": node.id})
+
+    def heartbeat(self, node_id: str) -> float:
+        return self._call("Nomad.heartbeat", {"node_id": node_id})
+
+    def pull_allocs(self, node_id: str, min_index: int, timeout: float):
+        resp = self._call(
+            "Nomad.pull_allocs",
+            {"node_id": node_id, "min_index": min_index, "timeout": timeout},
+        )
+        return resp["allocs"], resp["index"]
+
+    def update_allocs(self, updates) -> None:
+        self._call(
+            "Nomad.update_allocs_from_client", {"updates": list(updates)}
+        )
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            c.close()

@@ -11,6 +11,8 @@ with per-kind thresholds; limits per pass mirror maxIdsPerReap.
 from __future__ import annotations
 
 import threading
+
+from .fsm import MsgType
 import time
 from typing import Optional
 
@@ -107,11 +109,9 @@ class CoreScheduler:
             if len(reap_evals) >= MAX_IDS_PER_REAP:
                 break
         if reap_evals:
-            self.server._raft_apply(
-                lambda index: (
-                    store.delete_evals(index, reap_evals),
-                    store.delete_allocs(index, reap_allocs),
-                )
+            self.server.raft_apply(
+                MsgType.JOB_BATCH_GC,
+                {"eval_ids": reap_evals, "alloc_ids": reap_allocs},
             )
         return len(reap_evals)
 
@@ -132,12 +132,13 @@ class CoreScheduler:
             evs = store.evals_by_job(job.namespace, job.id)
             if any(not e.terminal_status() for e in evs):
                 continue
-            self.server._raft_apply(
-                lambda index, j=job, a=allocs, e=evs: (
-                    store.delete_evals(index, [x.id for x in e]),
-                    store.delete_allocs(index, [x.id for x in a]),
-                    store.delete_job(index, j.namespace, j.id),
-                )
+            self.server.raft_apply(
+                MsgType.JOB_BATCH_GC,
+                {
+                    "eval_ids": [x.id for x in evs],
+                    "alloc_ids": [x.id for x in allocs],
+                    "jobs": [(job.namespace, job.id)],
+                },
             )
             reaped += 1
         return reaped
@@ -157,8 +158,8 @@ class CoreScheduler:
                 not a.terminal_status() for a in store.allocs_by_node(node.id)
             ):
                 continue
-            self.server._raft_apply(
-                lambda index, n=node: store.delete_node(index, n.id)
+            self.server.raft_apply(
+                MsgType.JOB_BATCH_GC, {"node_ids": [node.id]}
             )
             reaped += 1
         return reaped
@@ -173,8 +174,8 @@ class CoreScheduler:
                 f"deploy:{d.id}", self.config.deployment_gc_threshold_s, now
             ):
                 continue
-            self.server._raft_apply(
-                lambda index, dd=d: store.delete_deployment(index, dd.id)
+            self.server.raft_apply(
+                MsgType.JOB_BATCH_GC, {"deployment_ids": [d.id]}
             )
             reaped += 1
         return reaped

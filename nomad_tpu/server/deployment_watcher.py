@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import copy
 import threading
+
+from .fsm import MsgType
 import time
 from typing import Optional
 
@@ -90,10 +92,10 @@ class DeploymentWatcher:
                 else:
                     self._running_since.pop(a.id, None)
             if healthy_ids or unhealthy_ids:
-                self.server._raft_apply(
-                    lambda index: store.update_alloc_health(
-                        index, healthy_ids, unhealthy_ids
-                    )
+                self.server.raft_apply(
+                    MsgType.ALLOC_HEALTH,
+                    {"healthy_ids": healthy_ids,
+                     "unhealthy_ids": unhealthy_ids},
                 )
                 for aid in healthy_ids + unhealthy_ids:
                     self._running_since.pop(aid, None)  # verdict settled
@@ -152,18 +154,17 @@ class DeploymentWatcher:
                 s.healthy_allocs >= s.desired_total
                 for s in d.task_groups.values()
             ):
-                self.server._raft_apply(
-                    lambda index: self.server.store.update_deployment_status(
-                        index, d.id, DEPLOYMENT_STATUS_SUCCESSFUL, DESC_SUCCESSFUL
-                    )
+                self.server.raft_apply(
+                    MsgType.DEPLOYMENT_STATUS,
+                    {"deployment_id": d.id,
+                     "status": DEPLOYMENT_STATUS_SUCCESSFUL,
+                     "description": DESC_SUCCESSFUL},
                 )
                 if job is not None and job.version == d.job_version:
                     stable = copy.copy(job)
                     stable.stable = True
-                    self.server._raft_apply(
-                        lambda index: self.server.store.mark_job_stable(
-                            index, stable
-                        )
+                    self.server.raft_apply(
+                        MsgType.JOB_STABLE, {"job": stable}
                     )
                 continue
 
@@ -192,9 +193,7 @@ class DeploymentWatcher:
         d2 = copy.deepcopy(d)
         for s in d2.task_groups.values():
             s.promoted = True
-        self.server._raft_apply(
-            lambda index: store.update_deployment(index, d2)
-        )
+        self.server.raft_apply(MsgType.DEPLOYMENT_UPSERT, {"deployment": d2})
         job = store.job_by_id(d.namespace, d.job_id)
         if job is not None:
             self._create_eval(job)
@@ -211,10 +210,10 @@ class DeploymentWatcher:
         auto_revert = any(s.auto_revert for s in d.task_groups.values())
         if auto_revert:
             desc = desc + "; " + DESC_AUTO_REVERT
-        self.server._raft_apply(
-            lambda index: self.server.store.update_deployment_status(
-                index, d.id, DEPLOYMENT_STATUS_FAILED, desc
-            )
+        self.server.raft_apply(
+            MsgType.DEPLOYMENT_STATUS,
+            {"deployment_id": d.id, "status": DEPLOYMENT_STATUS_FAILED,
+             "description": desc},
         )
         if auto_revert and job is not None and d.job_version > 0:
             # revert to the latest *stable* version (not merely version-1,
@@ -279,8 +278,8 @@ class DeploymentWatcher:
                 s.placed_canaries = canary_ids
                 changed = True
         if changed:
-            self.server._raft_apply(
-                lambda index: self.server.store.update_deployment(index, d2)
+            self.server.raft_apply(
+                MsgType.DEPLOYMENT_UPSERT, {"deployment": d2}
             )
             d.task_groups = d2.task_groups
 

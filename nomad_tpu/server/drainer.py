@@ -22,6 +22,8 @@ Semantics kept:
 from __future__ import annotations
 
 import logging
+
+from .fsm import MsgType
 import threading
 import time
 from typing import Optional
@@ -179,25 +181,24 @@ class NodeDrainer:
             for (ns, job_id), job in jobs_touched.items()
         ]
 
-        def apply(index):
-            store.update_allocs_desired_transition(index, transitions)
-            if evals:
-                store.upsert_evals(index, evals)
-
-        self.server._raft_apply(apply)
+        self.server.raft_apply(
+            MsgType.ALLOC_DESIRED_TRANSITION,
+            {"transitions": transitions, "evals": evals},
+        )
         if evals:
-            self.server.eval_broker.enqueue_all(evals)
+            self.server.eval_broker.enqueue_all(
+                self.server._fresh_evals(evals)
+            )
 
     def _complete(self, node, deadlined: bool) -> None:
         """Drain finished: clear the strategy, stay ineligible
         (drainer.go handleDoneNodeDrains → Node.UpdateDrain with nil)."""
         from ..structs import NODE_SCHED_INELIGIBLE
 
-        store = self.server.store
-        self.server._raft_apply(
-            lambda index: store.update_node_drain(
-                index, node.id, None, eligibility=NODE_SCHED_INELIGIBLE
-            )
+        self.server.raft_apply(
+            MsgType.NODE_DRAIN,
+            {"node_id": node.id, "drain": None,
+             "eligibility": NODE_SCHED_INELIGIBLE},
         )
         self.server._publish(
             "Node",

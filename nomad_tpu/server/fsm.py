@@ -73,8 +73,23 @@ class FSM:
             # Unknown message from a newer version: tolerate, don't crash
             # the FSM (fsm.go ignores with an error log for forward compat).
             log.error("fsm: unknown message type %s at index %d", mtype, index)
+            self.store.bump_index(index)
             return None
-        return handler(self, self.store, index, payload or {})
+        # Appliers must NEVER let an exception escape: the entry is already
+        # durably logged/replicated, so raising would desync the index
+        # sequence (poisoning WAL contiguity) and crash log replay on boot.
+        # A rejection is a deterministic no-op + error result — identical
+        # on every replica since it depends only on store state. (e.g. a
+        # NODE_STATUS for a node that GC reaped between submit and apply.)
+        try:
+            return handler(self, self.store, index, payload or {})
+        except Exception as e:  # noqa: BLE001 — invariant, see above
+            log.warning(
+                "fsm: applier %s rejected entry at index %d: %s",
+                MsgType(mtype).name, index, e,
+            )
+            self.store.bump_index(index)
+            return e
 
 
 # -- appliers (fsm.go:62-73 LogAppliers table) ------------------------------
@@ -168,16 +183,9 @@ def _apply_deployment_upsert(fsm, store, index, p):
 
 
 def _apply_csi_volume_upsert(fsm, store, index, p):
-    # appliers must NEVER raise: the entry is already durably logged and
-    # replicated, so replay/followers would crash on the same input. A
-    # rejected registration is a deterministic no-op + error result —
-    # identical on every replica since it depends only on store state.
-    try:
-        store.upsert_csi_volume(index, p["volume"])
-        return None
-    except ValueError as e:
-        store.bump_index(index)
-        return e
+    # rejections (spec change on in-use volume) surface via the generic
+    # never-raise guard in FSM.apply as a returned ValueError
+    store.upsert_csi_volume(index, p["volume"])
 
 
 def _apply_csi_volume_deregister(fsm, store, index, p):

@@ -5,13 +5,11 @@ nomad/leader.go (:230-347 establishLeadership: enable plan queue, spawn
 planApply, enable eval broker + blocked evals, restore queues from durable
 state, pause half the workers).
 
-Round-1 scope: a single-process server whose "Raft apply" is a serialized
-in-memory commit with monotonically increasing indexes (the consensus
-transport slots in behind ``_raft_apply`` later — SURVEY.md §7 step 8
-explicitly sequences "single-node WAL first"). Everything above that seam
-— eval lifecycle, node-update fan-out to evals, blocked-eval unblocking on
-capacity change, worker scheduling through the plan queue — is the real
-protocol.
+Every cluster write is a typed FSM message (server/fsm.py) submitted
+through ``raft_apply`` — backed by InlineRaft (single server, optional WAL
+durability + replay-on-boot) or a full RaftNode consensus group
+(nomad_tpu.raft) when peers are configured. Mirrors nomad/server.go:
+endpoints build requests, the FSM is the only state-store writer.
 """
 
 from __future__ import annotations
@@ -54,12 +52,14 @@ class ServerConfig:
         heartbeat_ttl: float = 5.0,
         deployment_watch_interval: float = 0.25,
         acl_enabled: bool = False,
+        data_dir: Optional[str] = None,
     ):
         self.num_workers = num_workers
         self.region = region
         self.heartbeat_ttl = heartbeat_ttl
         self.deployment_watch_interval = deployment_watch_interval
         self.acl_enabled = acl_enabled
+        self.data_dir = data_dir
 
 
 class Server:
@@ -72,6 +72,7 @@ class Server:
         self.plan_apply_loop = PlanApplyLoop(
             self.store, self.plan_queue,
             on_evals_created=self.eval_broker.enqueue_all,
+            commit=self._commit_plan_result,
         )
         self.workers: list[Worker] = []
         self._raft_lock = threading.Lock()
@@ -99,6 +100,34 @@ class Server:
         self.acl = ACLService(self)
         # capacity changes unblock blocked evals (blocked_evals.go:55)
         self.store.add_listener(self._on_state_change)
+        # the raft seam: FSM messages through InlineRaft (single server;
+        # WAL-durable when data_dir is set). A consensus RaftNode swaps in
+        # via attach_raft() for clustered servers.
+        from ..raft import InlineRaft
+        from ..state.snapshot import restore_snapshot, save_snapshot
+        from .fsm import FSM, MsgType
+
+        self._msg = MsgType
+        self.fsm = FSM(lambda: self.store)
+        self.raft = InlineRaft(
+            self.fsm,
+            data_dir=self.config.data_dir,
+            snapshot_fn=lambda path: save_snapshot(self.store, path),
+            restore_fn=lambda path: self._install_store(restore_snapshot(path)),
+        )
+        if self.config.data_dir:
+            self.raft.restore()
+
+    def _install_store(self, store) -> int:
+        """Swap in a restored StateStore (snapshot restore / install)."""
+        self.store = store
+        self.plan_apply_loop.applier.store = store
+        store.add_listener(self._on_state_change)
+        return store.latest_index
+
+    def attach_raft(self, raft) -> None:
+        """Replace the inline seam with a consensus RaftNode (cluster)."""
+        self.raft = raft
 
     @classmethod
     def from_snapshot(cls, path: str, config: Optional[ServerConfig] = None):
@@ -107,21 +136,41 @@ class Server:
         from ..state.snapshot import restore_snapshot
 
         server = cls(config)
-        restored = restore_snapshot(path)
-        # swap the fresh store for the restored one, rewiring listeners
-        server.store = restored
-        server.plan_apply_loop.applier.store = restored
-        server.store.add_listener(server._on_state_change)
+        server._install_store(restore_snapshot(path))
         return server
 
+    def _commit_plan_result(self, result, eval_id, evals) -> int:
+        index, _ = self.raft_apply(
+            self._msg.PLAN_RESULT,
+            {"result": result, "eval_id": eval_id, "evals": evals},
+        )
+        return index
+
+    def _fresh_evals(self, evals):
+        """Re-read evals from the store after a raft commit: with a real
+        consensus group the FSM applies unpickled COPIES, so the submitted
+        objects lack the committed modify_index the worker's
+        snapshot-min-index wait (worker.py:88) depends on."""
+        out = []
+        for ev in evals:
+            out.append(self.store.eval_by_id(ev.id) or ev)
+        return out
+
     # -- raft seam ---------------------------------------------------------
-    def _raft_apply(self, fn) -> int:
-        """Serialized commit: allocate the next index and apply. The Raft
-        log + FSM replay slots in here without touching callers."""
-        with self._raft_lock:
-            index = self.store.latest_index + 1
-            fn(index)
-            return index
+    def raft_apply(self, mtype, payload=None):
+        """Submit one FSM message through the raft seam; returns
+        (index, applier_result). Raises NotLeaderError on a follower —
+        the RPC layer forwards to the leader (nomad/rpc.go forward())."""
+        return self.raft.apply(mtype, payload)
+
+    def raft_apply_checked(self, mtype, payload=None):
+        """raft_apply for user-facing endpoints: a rejection the FSM
+        returned as a result (appliers never raise) is re-raised here, on
+        the submitting server only."""
+        index, result = self.raft.apply(mtype, payload)
+        if isinstance(result, Exception):
+            raise result
+        return index, result
 
     # -- leadership --------------------------------------------------------
     def establish_leadership(self) -> None:
@@ -163,6 +212,11 @@ class Server:
     def shutdown(self) -> None:
         if self._leader:
             self.revoke_leadership()
+        # flush + release the durable log (InlineRaft.close is idempotent;
+        # a consensus RaftNode is owned and closed by its ClusterServer)
+        close = getattr(self.raft, "close", None)
+        if close is not None:
+            close()
 
     def _restore_evals(self) -> None:
         """Re-populate broker/blocked from durable state on leadership
@@ -190,13 +244,10 @@ class Server:
             status=EVAL_STATUS_PENDING,
         )
 
-        def apply(index):
-            self.store.upsert_job(index, job)
-            if needs_eval:
-                ev.job_modify_index = index
-                self.store.upsert_evals(index, [ev])
-
-        self._raft_apply(apply)
+        self.raft_apply(
+            self._msg.JOB_UPSERT,
+            {"job": job, "evals": [ev] if needs_eval else []},
+        )
         self.blocked_evals.untrack(job.namespace, job.id)
         self._publish(
             "Job", "JobRegistered", job.id, job.namespace, {"job_id": job.id}
@@ -204,6 +255,7 @@ class Server:
         if job.is_periodic():
             self.periodic.add(job)
         if needs_eval:
+            (ev,) = self._fresh_evals([ev])
             self.eval_broker.enqueue(ev)
         return ev
 
@@ -261,22 +313,19 @@ class Server:
             status=EVAL_STATUS_PENDING,
         )
 
-        def apply(index):
-            self.store.upsert_job(index, stopped)
-            self.store.upsert_evals(index, [ev])
-
-        self._raft_apply(apply)
+        self.raft_apply(self._msg.JOB_UPSERT, {"job": stopped, "evals": [ev]})
         self.blocked_evals.untrack(namespace, job_id)
         self.periodic.remove(namespace, job_id)
         self._publish(
             "Job", "JobDeregistered", job_id, namespace, {"job_id": job_id}
         )
+        (ev,) = self._fresh_evals([ev])
         self.eval_broker.enqueue(ev)
         return ev
 
     # -- API: nodes --------------------------------------------------------
     def register_node(self, node: Node) -> None:
-        self._raft_apply(lambda index: self.store.upsert_node(index, node))
+        self.raft_apply(self._msg.NODE_UPSERT, {"node": node})
         self._publish(
             "Node", "NodeRegistration", node.id, "default", {"node_id": node.id}
         )
@@ -284,8 +333,8 @@ class Server:
     def update_node_status(self, node_id: str, status: str) -> list[Evaluation]:
         """Node.UpdateStatus: commit + fan out node-update evals for every
         job with allocs on the node (nomad/node_endpoint.go createNodeEvals)."""
-        self._raft_apply(
-            lambda index: self.store.update_node_status(index, node_id, status)
+        self.raft_apply(
+            self._msg.NODE_STATUS, {"node_id": node_id, "status": status}
         )
         self._publish(
             "Node", "NodeStatusUpdate", node_id, "default", {"status": status}
@@ -310,12 +359,10 @@ class Server:
                 if not a.terminal_status() and a.desired_transition.migrate:
                     resets[a.id] = _DT(migrate=False)
 
-        def apply(index):
-            self.store.update_node_drain(index, node_id, drain)
-            if resets:
-                self.store.update_allocs_desired_transition(index, resets)
-
-        self._raft_apply(apply)
+        self.raft_apply(
+            self._msg.NODE_DRAIN,
+            {"node_id": node_id, "drain": drain, "transitions": resets},
+        )
         return self._create_node_evals(node_id)
 
     def _create_node_evals(self, node_id: str) -> list[Evaluation]:
@@ -354,20 +401,20 @@ class Server:
                         )
                     )
         if evals:
-            self._raft_apply(lambda index: self.store.upsert_evals(index, evals))
+            self.raft_apply(self._msg.EVAL_UPSERT, {"evals": evals})
+            evals = self._fresh_evals(evals)
             self.eval_broker.enqueue_all(evals)
         return evals
 
     # -- API: client alloc updates ----------------------------------------
     # -- CSI volumes (csi_endpoint.go Register/Deregister/Claim) -----------
     def register_csi_volume(self, vol) -> None:
-        self._raft_apply(lambda index: self.store.upsert_csi_volume(index, vol))
+        self.raft_apply_checked(self._msg.CSI_VOLUME_UPSERT, {"volume": vol})
 
     def deregister_csi_volume(self, volume_id: str, force: bool = False) -> None:
-        self._raft_apply(
-            lambda index: self.store.deregister_csi_volume(
-                index, volume_id, force=force
-            )
+        self.raft_apply_checked(
+            self._msg.CSI_VOLUME_DEREGISTER,
+            {"volume_id": volume_id, "force": force},
         )
 
     def claim_csi_volume(
@@ -377,27 +424,18 @@ class Server:
         eagerly, so this is for external/API claimants. Claims whose id is
         not a live alloc are marked external so the volume watcher never
         reaps them as "alloc gone"."""
-        out: list[bool] = []
-
-        def apply(index: int) -> None:
-            # classify under the raft lock: a plan apply inserting this
-            # alloc concurrently must not race the external check
-            external = self.store.alloc_by_id(alloc_id) is None
-            out.append(
-                self.store.csi_claim(
-                    index, volume_id, alloc_id, node_id, read_only,
-                    external=external,
-                )
-            )
-
-        self._raft_apply(apply)
-        return bool(out and out[0])
+        _i, ok = self.raft_apply(
+            self._msg.CSI_CLAIM,
+            {
+                "volume_id": volume_id, "claim_id": alloc_id,
+                "node_id": node_id, "read_only": read_only,
+            },
+        )
+        return bool(ok)
 
     def update_allocs_from_client(self, updates: Iterable[Allocation]) -> None:
         updates = list(updates)
-        self._raft_apply(
-            lambda index: self.store.update_allocs_from_client(index, updates)
-        )
+        self.raft_apply(self._msg.ALLOC_CLIENT_UPDATE, {"updates": updates})
         for u in updates:
             self._publish(
                 "Allocation",
@@ -435,19 +473,19 @@ class Server:
                 )
             )
         if evals:
-            self._raft_apply(lambda index: self.store.upsert_evals(index, evals))
-            self.eval_broker.enqueue_all(evals)
+            self.raft_apply(self._msg.EVAL_UPSERT, {"evals": evals})
+            self.eval_broker.enqueue_all(self._fresh_evals(evals))
 
     # -- eval lifecycle (worker callbacks) ---------------------------------
     def apply_eval_update(self, evals: list[Evaluation]) -> None:
-        self._raft_apply(lambda index: self.store.upsert_evals(index, evals))
-        for ev in evals:
+        self.raft_apply(self._msg.EVAL_UPSERT, {"evals": evals})
+        for ev in self._fresh_evals(evals):
             if ev.status == EVAL_STATUS_BLOCKED:
                 self.blocked_evals.block(ev)
 
     def apply_eval_create(self, evals: list[Evaluation]) -> None:
-        self._raft_apply(lambda index: self.store.upsert_evals(index, evals))
-        for ev in evals:
+        self.raft_apply(self._msg.EVAL_UPSERT, {"evals": evals})
+        for ev in self._fresh_evals(evals):
             if ev.status == EVAL_STATUS_BLOCKED:
                 self.blocked_evals.block(ev)
             elif ev.wait_until_unix:

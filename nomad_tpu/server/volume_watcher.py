@@ -11,6 +11,8 @@ becomes claimable by the next placement (the scheduling-visible effect).
 from __future__ import annotations
 
 import threading
+
+from .fsm import MsgType
 from typing import Optional
 
 
@@ -53,14 +55,10 @@ class VolumeWatcher:
                     continue  # released only by an explicit Unpublish/API call
                 alloc = store.alloc_by_id(alloc_id)
                 if alloc is None or alloc.terminal_status():
-                    out: list[bool] = []
-                    # release through the raft seam so the index allocation
-                    # stays serialized with every other commit
-                    self.server._raft_apply(
-                        lambda index: out.append(
-                            store.csi_release(index, vol.id, alloc_id)
-                        )
+                    _i, ok = self.server.raft_apply(
+                        MsgType.CSI_RELEASE,
+                        {"volume_id": vol.id, "claim_id": alloc_id},
                     )
-                    if out and out[0]:
+                    if ok:
                         released += 1
         return released

@@ -1,0 +1,271 @@
+"""Clustered control-plane tests: 3 consensus servers over TCP, write
+forwarding from followers, leader-only scheduling services, full
+job→eval→plan→alloc replication, a real client agent over the remote RPC
+transport, and leader failover with rescheduling.
+
+Reference shape: nomad in-process multi-server tests (nomad/testing.go:44,
+leader_test.go) + client/rpc.go server failover.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.rpc import RPCServer
+from nomad_tpu.server.cluster import ClusterServer, RemoteClientRPC
+from nomad_tpu.server.server import ServerConfig
+
+FAST = dict(
+    election_timeout_min=0.10,
+    election_timeout_max=0.25,
+    heartbeat_interval=0.04,
+)
+
+
+def wait_until(fn, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+class TestCluster:
+    @pytest.fixture
+    def cluster(self, tmp_path):
+        rpcs = [RPCServer() for _ in range(3)]
+        for r in rpcs:
+            r.start()
+        ids = [f"s{i}" for i in range(3)]
+        peers = {ids[i]: rpcs[i].address for i in range(3)}
+        servers = [
+            ClusterServer(
+                ids[i], peers, rpcs[i],
+                data_dir=str(tmp_path / ids[i]),
+                server_config=ServerConfig(num_workers=1, heartbeat_ttl=2.0),
+                **FAST,
+            )
+            for i in range(3)
+        ]
+        for s in servers:
+            s.start()
+        yield servers
+        for s in servers:
+            s.shutdown()
+        for r in rpcs:
+            r.stop()
+
+    def leader_of(self, servers):
+        return wait_until(
+            lambda: next(
+                (s for s in servers if s.raft.is_leader()), None
+            ),
+            msg="leader election",
+        )
+
+    def test_schedule_through_follower_replicates_everywhere(self, cluster):
+        leader = self.leader_of(cluster)
+        wait_until(lambda: leader.server._leader, msg="leader services up")
+        follower = next(s for s in cluster if s is not leader)
+
+        # node + job registered THROUGH THE FOLLOWER: forwarded to leader
+        node = mock.node()
+        follower.rpc  # (talking via its RPC surface, as a CLI would)
+        from nomad_tpu.rpc import RPCClient
+
+        c = RPCClient(follower.rpc.address)
+        c.call("Nomad.register_node", {"node": node})
+        job = mock.job()
+        c.call("Nomad.register_job", {"job": job})
+
+        # one mock node fits only part of the 10-count job: the leader
+        # places what fits and parks a blocked eval awaiting capacity
+        wait_until(
+            lambda: any(
+                e.status == "blocked"
+                for e in leader.server.store.evals_by_job("default", job.id)
+            ),
+            msg="blocked eval for the unplaceable remainder",
+        )
+        partial = len(leader.server.store.allocs_by_job("default", job.id))
+        assert 0 < partial < job.task_groups[0].count
+
+        # new capacity through the follower → blocked eval unblocks →
+        # remainder places; the full set replicates to every server
+        c.call("Nomad.register_node", {"node": mock.node()})
+        want = job.task_groups[0].count
+
+        def placed_everywhere():
+            return all(
+                len(s.server.store.allocs_by_job("default", job.id)) == want
+                for s in cluster
+            )
+
+        wait_until(placed_everywhere, msg="allocs replicated to all servers")
+        # eval completed and identical across servers
+        evs = leader.server.store.evals_by_job("default", job.id)
+        assert any(e.status == "complete" for e in evs)
+        c.close()
+
+    def test_client_agent_over_tcp_runs_allocs(self, cluster, tmp_path):
+        from nomad_tpu.client.client import Client
+
+        leader = self.leader_of(cluster)
+        wait_until(lambda: leader.server._leader, msg="leader services up")
+
+        rpc = RemoteClientRPC([s.rpc.address for s in cluster])
+        client = Client(
+            rpc, data_dir=str(tmp_path / "client"),
+            heartbeat_interval=0.2,
+        )
+        client.start()
+        try:
+            job = mock.job()
+            for t in job.task_groups[0].tasks:
+                t.driver = "mock_driver"
+                t.config = {"run_for": 10.0}
+            leader.server.register_job(job)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in leader.server.store.allocs_by_job(
+                        "default", job.id
+                    )
+                ),
+                msg="alloc running on remote client",
+            )
+            # the running status replicated to followers too
+            f = next(s for s in cluster if s is not leader)
+            wait_until(
+                lambda: any(
+                    a.client_status == "running"
+                    for a in f.server.store.allocs_by_job("default", job.id)
+                ),
+                msg="running status replicated",
+            )
+        finally:
+            client.shutdown()
+            rpc.close()
+
+    def test_leader_failover_keeps_scheduling(self, cluster):
+        leader = self.leader_of(cluster)
+        wait_until(lambda: leader.server._leader, msg="leader services up")
+        node = mock.node()
+        leader.server.register_node(node)
+        j1 = mock.job()
+        j1.task_groups[0].count = 2  # leave headroom for the second job
+        leader.server.register_job(j1)
+        wait_until(
+            lambda: leader.server.store.allocs_by_job("default", j1.id),
+            msg="first job placed",
+        )
+
+        # kill the leader (process death: rpc + raft)
+        dead_rpc = leader.rpc
+        leader.shutdown()
+        dead_rpc.stop()
+        survivors = [s for s in cluster if s is not leader]
+        new_leader = wait_until(
+            lambda: next(
+                (s for s in survivors if s.raft.is_leader()), None
+            ),
+            msg="new leader",
+        )
+        wait_until(
+            lambda: new_leader.server._leader,
+            msg="new leader services up",
+        )
+        # state survived the failover
+        assert new_leader.server.store.node_by_id(node.id) is not None
+        assert new_leader.server.store.allocs_by_job("default", j1.id)
+        # and new work schedules
+        j2 = mock.job()
+        j2.task_groups[0].count = 2
+        new_leader.server.register_job(j2)
+        wait_until(
+            lambda: new_leader.server.store.allocs_by_job("default", j2.id),
+            msg="post-failover job placed",
+        )
+        other = next(s for s in survivors if s is not new_leader)
+        wait_until(
+            lambda: other.server.store.allocs_by_job("default", j2.id),
+            msg="post-failover allocs replicated",
+        )
+
+
+class TestDurableSingleServer:
+    """InlineRaft + data_dir: the dev agent's checkpoint/resume — every
+    commit WAL-logged, snapshot+replay on boot (fsm.go Snapshot/Restore +
+    raft-boltdb persistence, collapsed to one server)."""
+
+    def test_restart_recovers_full_state(self, tmp_path):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        datadir = str(tmp_path / "server")
+        srv = Server(ServerConfig(num_workers=1, data_dir=datadir))
+        srv.establish_leadership()
+        try:
+            node = mock.node()
+            srv.register_node(node)
+            job = mock.job()
+            job.task_groups[0].count = 3
+            srv.register_job(job)
+            wait_until(
+                lambda: len(srv.store.allocs_by_job("default", job.id)) == 3,
+                msg="initial placement",
+            )
+            pre_allocs = {
+                a.id for a in srv.store.allocs_by_job("default", job.id)
+            }
+            pre_index = srv.store.latest_index
+        finally:
+            srv.shutdown()
+            srv.raft.close()
+
+        # cold restart from the same data_dir: WAL replay rebuilds state
+        srv2 = Server(ServerConfig(num_workers=1, data_dir=datadir))
+        try:
+            assert srv2.store.latest_index == pre_index
+            assert srv2.store.node_by_id(node.id) is not None
+            assert {
+                a.id for a in srv2.store.allocs_by_job("default", job.id)
+            } == pre_allocs
+            j = srv2.store.job_by_id("default", job.id)
+            assert j is not None and j.task_groups[0].count == 3
+            # and the restarted server keeps scheduling
+            srv2.establish_leadership()
+            j2 = mock.job()
+            j2.task_groups[0].count = 2
+            srv2.register_job(j2)
+            wait_until(
+                lambda: len(srv2.store.allocs_by_job("default", j2.id)) == 2,
+                msg="post-restart placement",
+            )
+        finally:
+            srv2.shutdown()
+            srv2.raft.close()
+
+    def test_snapshot_compaction_then_restart(self, tmp_path):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        datadir = str(tmp_path / "server")
+        srv = Server(ServerConfig(num_workers=0, data_dir=datadir))
+        try:
+            for i in range(50):
+                srv.register_node(mock.node())
+            srv.raft.snapshot()  # operator checkpoint: snapshot + compact
+            for i in range(10):
+                srv.register_node(mock.node())
+            n_nodes = len(list(srv.store.nodes()))
+            idx = srv.store.latest_index
+        finally:
+            srv.raft.close()
+        srv2 = Server(ServerConfig(num_workers=0, data_dir=datadir))
+        try:
+            assert len(list(srv2.store.nodes())) == n_nodes
+            assert srv2.store.latest_index == idx
+        finally:
+            srv2.raft.close()

@@ -184,8 +184,11 @@ class TestEndToEnd:
         class FakeServer:
             store = h.store
 
-            def _raft_apply(self, fn):
-                fn(h.store.latest_index + 1)
+            def raft_apply(self, mtype, payload=None):
+                from nomad_tpu.server.fsm import FSM
+
+                index = h.store.latest_index + 1
+                return index, FSM(lambda: h.store).apply(index, mtype, payload)
 
         from nomad_tpu.server.volume_watcher import VolumeWatcher
 
@@ -303,13 +306,13 @@ class TestReviewRegressions:
             vol = srv.store.csi_volume_by_id("vol1")
             assert "external-user-1" in vol.write_claims
             # explicit release still works
-            out = []
-            srv._raft_apply(
-                lambda i: out.append(
-                    srv.store.csi_release(i, "vol1", "external-user-1")
-                )
+            from nomad_tpu.server.fsm import MsgType
+
+            _i, ok = srv.raft_apply(
+                MsgType.CSI_RELEASE,
+                {"volume_id": "vol1", "claim_id": "external-user-1"},
             )
-            assert out[0]
+            assert ok
             assert not srv.store.csi_volume_by_id("vol1").write_claims
         finally:
             srv.shutdown()
