@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -308,6 +309,157 @@ def _steps_bucket(n: int) -> int:
     return b
 
 
+# -- closed-form greedy (the TPU-shaped fast path) ---------------------------
+#
+# For one group placing ``count`` IDENTICAL asks, each node's score as a
+# function of j (instances of this group already placed on it) is a closed
+# form: usage is used0 + j·ask, collisions are job_counts0 + j. With no
+# spread block (whose boost couples nodes through global per-value counts),
+# node scores are independent, and the per-node score sequence s[n, j] is
+# monotone non-increasing in j (binpack worsens with usage, anti-affinity
+# grows; the single non-monotone corner — a penalty term diluting the
+# normalization mean at the j=0→1 component-count change — is clamped by a
+# running min). Greedy placement then equals: take the ``count`` largest
+# entries of the [N, J] matrix under the prefix rule "(n, j) requires
+# (n, j-1)" — which monotone rows turn into a plain top-k over the
+# flattened matrix. One fully-parallel scoring pass + one top_k replaces
+# ``count`` sequential scan steps.
+#
+# This is the "batched dense score matrix" BASELINE.json names as the
+# north-star replacement for the reference's per-placement iterator walk
+# (scheduler/rank.go:193-527): O(N·J) parallel work, O(log) depth.
+
+
+@functools.partial(jax.jit, static_argnames=("max_j", "k"))
+def place_closed_form_kernel(
+    capacity,  # f32[N, D] shared
+    used0,  # f32[N, D] shared snapshot usage
+    asks,  # f32[G, D]
+    eligible,  # bool[G, N]
+    job_counts,  # i32[G, N]
+    desired_totals,  # f32[G]
+    penalty_nodes,  # bool[G, N]
+    affinity_scores,  # f32[G, N]
+    has_affinities,  # bool[G]
+    distinct_hosts,  # bool[G]
+    slot_caps,  # f32[G, N]
+    algorithm_spread,  # bool[]
+    counts,  # i32[G]
+    max_j: int,  # static: max instances of one group per node
+    k: int,  # static: top-k width (≥ max count in batch)
+):
+    """Returns (choices i32[G, k], scores f32[G, k]) — node row per
+    placement step in greedy order, −1 past count/capacity."""
+
+    js = jnp.arange(max_j, dtype=jnp.float32)  # [J]
+
+    def one_group(ask, elig, jc0, dt, pen, aff, has_aff, dh, caps, count):
+        # Work in [N, J] planes only — a [N, J, D] temp is N·J·D·4 bytes
+        # and OOMs at 40k-node scale; the D axis is tiny and static, so
+        # unroll it (proposed usage after the (j+1)-th instance is
+        # used0[:, d] + (j+1)·ask[d]).
+        mult = js[None, :] + 1.0  # [1, J]
+        fits = elig[:, None] & jnp.ones((1, js.shape[0]), dtype=bool)
+        for d in range(capacity.shape[1]):
+            prop_d = used0[:, d:d + 1] + mult * ask[d]
+            fits &= prop_d <= capacity[:, d:d + 1]
+        # distinct_hosts ⇒ only j=0 and only where no existing collision
+        dh_mask = jnp.where(dh, (js[None, :] == 0) & (jc0[:, None] == 0), True)
+        fits &= dh_mask
+        fits &= js[None, :] < caps[:, None]  # device-slot caps
+
+        pow_sum = jnp.zeros_like(fits, dtype=jnp.float32)
+        for d in (0, 1):  # cpu, mem drive the fit score
+            cap_d = capacity[:, d:d + 1]
+            prop_d = used0[:, d:d + 1] + mult * ask[d]
+            free_d = jnp.where(
+                cap_d > 0, (cap_d - prop_d) / jnp.maximum(cap_d, 1e-9), 1.0
+            )
+            pow_sum = pow_sum + _pow10(free_d)
+        binpack = jnp.clip(20.0 - pow_sum, 0.0, BINPACK_MAX_SCORE)
+        spread_fit = jnp.clip(pow_sum - 2.0, 0.0, BINPACK_MAX_SCORE)
+        fit_score = (
+            jnp.where(algorithm_spread, spread_fit, binpack) / BINPACK_MAX_SCORE
+        )
+
+        coll = jc0[:, None].astype(jnp.float32) + js[None, :]  # after j placed
+        has_coll = coll > 0
+        anti = jnp.where(
+            has_coll, -(coll + 1.0) / jnp.maximum(dt, 1.0), 0.0
+        )
+        resched = jnp.where(pen[:, None], -1.0, 0.0)
+        aff_c = jnp.where(has_aff, aff[:, None], 0.0)
+        n_comp = (
+            1.0
+            + has_coll
+            + pen[:, None]
+            + jnp.where(has_aff, 1.0, 0.0)
+        )
+        s_raw = (fit_score + anti + resched + aff_c) / n_comp  # [N, J]
+        s_raw = jnp.where(fits, s_raw, -jnp.inf)
+        # Selection runs on the running-min clamp: it restores the prefix
+        # rule "(n,j) requires (n,j-1)" that plain top-k needs. Binpack is
+        # best-fit, so per-node sequences can RISE as a node fills; the
+        # clamp flattens a rising run to its initial head — top-k then
+        # fills nodes in descending initial-score order, which is exactly
+        # what stepwise greedy does with rising heads (a rising head stays
+        # max until the node is exhausted).
+        s_sel = jax.lax.associative_scan(jnp.minimum, s_raw, axis=1)
+
+        flat_sel = s_sel.reshape(-1)  # [N*J]
+        flat_raw = s_raw.reshape(-1)
+        k_eff = min(k, flat_sel.shape[0])  # tiny clusters: < k slots total
+        top_sel, top_idx = jax.lax.top_k(flat_sel, k_eff)
+        if k_eff < k:
+            pad = k - k_eff
+            top_sel = jnp.concatenate(
+                [top_sel, jnp.full(pad, -jnp.inf, top_sel.dtype)]
+            )
+            top_idx = jnp.concatenate(
+                [top_idx, jnp.zeros(pad, top_idx.dtype)]
+            )
+        # report the TRUE (unclamped) score of each chosen (n, j) — the
+        # AllocMetric the oracle would have recorded for that placement
+        top_raw = flat_raw[top_idx]
+        node_rows = (top_idx // max_j).astype(jnp.int32)
+        step = jnp.arange(k)
+        ok = (top_sel > -jnp.inf) & (step < count)
+        return jnp.where(ok, node_rows, -1), jnp.where(
+            ok, top_raw, -jnp.inf
+        )
+
+    return jax.vmap(one_group)(
+        asks, eligible, job_counts, desired_totals, penalty_nodes,
+        affinity_scores, has_affinities, distinct_hosts, slot_caps, counts,
+    )
+
+
+def _shared_batch(asks: list, pn: int) -> dict:
+    """Host-side assembly of the kernel inputs common to both placement
+    paths (the spread-only fields are added by the scan path)."""
+    return dict(
+        asks=np.stack([a.ask for a in asks]),
+        eligible=np.stack([a.eligible for a in asks]),
+        job_counts=np.stack([a.job_counts for a in asks]),
+        desired_totals=np.array(
+            [a.desired_total for a in asks], dtype=np.float32
+        ),
+        penalty_nodes=np.stack([a.penalty_nodes for a in asks]),
+        affinity_scores=np.stack([a.affinity_scores for a in asks]),
+        has_affinities=np.array([a.has_affinities for a in asks]),
+        distinct_hosts=np.array([a.distinct_hosts for a in asks]),
+        slot_caps=np.stack(
+            [
+                a.slot_caps
+                if a.slot_caps is not None
+                else np.full(pn, np.inf, dtype=np.float32)
+                for a in asks
+            ]
+        ),
+        counts=np.array([a.count for a in asks], dtype=np.int32),
+    )
+
+
 @dataclass
 class PlacementResult:
     """Host-side result for one group: chosen node rows (−1 = failed) and
@@ -322,14 +474,80 @@ class PlacementKernel:
     compiled kernel, unpacks results. Shape-bucketed so node churn and
     varying batch sizes hit a small set of compiled programs."""
 
-    def __init__(self, algorithm: str = "binpack"):
+    def __init__(self, algorithm: str = "binpack", force_scan: bool = False):
         self.algorithm_spread = algorithm == "spread"
+        self.force_scan = force_scan  # parity testing: disable the fast path
 
     def place(self, cluster, asks: list) -> list[PlacementResult]:
         if not asks:
             return []
+        # split: spread-free groups take the closed-form top-k fast path
+        # (node scores decouple); spread blocks couple nodes through global
+        # per-value counts and keep the sequential scan
+        fast, slow = [], []
+        for i, a in enumerate(asks):
+            (slow if (a.has_spreads or self.force_scan) else fast).append(i)
+        out: list[Optional[PlacementResult]] = [None] * len(asks)
+        if fast:
+            for i, r in zip(fast, self._place_closed_form(
+                cluster, [asks[i] for i in fast]
+            )):
+                out[i] = r
+        if slow:
+            for i, r in zip(slow, self._place_scan_batch(
+                cluster, [asks[i] for i in slow]
+            )):
+                out[i] = r
+        return out
+
+    def _place_closed_form(self, cluster, asks: list) -> list[PlacementResult]:
         pn = cluster.padded_n
-        g = len(asks)
+        max_count = max(a.count for a in asks)
+        k = _steps_bucket(max(max_count, 1))
+        # J bound: most instances of one identical ask any node could hold
+        cap_max = np.asarray(cluster.capacity).max(axis=0)  # [D]
+        max_j = 1
+        for a in asks:
+            pos = a.ask > 0
+            if pos.any():
+                j = int(np.floor(np.min(cap_max[pos] / a.ask[pos]))) + 1
+            else:
+                j = a.count
+            max_j = max(max_j, min(j, a.count))
+        max_j = max(16, -(-max_j // 16) * 16)  # multiple-of-16 bucket
+
+        # chunk the group axis so the [chunk, N, J] planes stay within an
+        # HBM budget (~2 GB of live f32 planes)
+        bytes_per_lane = pn * max_j * 4 * 4
+        chunk = max(1, int((2 << 30) // max(bytes_per_lane, 1)))
+        if len(asks) > chunk:
+            out: list[PlacementResult] = []
+            for i in range(0, len(asks), chunk):
+                out.extend(
+                    self._place_closed_form(cluster, asks[i:i + chunk])
+                )
+            return out
+
+        batch = _shared_batch(asks, pn)
+        choices, scores = place_closed_form_kernel(
+            jnp.asarray(cluster.capacity),
+            jnp.asarray(cluster.used),
+            **{kk: jnp.asarray(v) for kk, v in batch.items()},
+            algorithm_spread=jnp.asarray(self.algorithm_spread),
+            max_j=max_j,
+            k=k,
+        )
+        choices = np.asarray(choices)
+        scores = np.asarray(scores)
+        return [
+            PlacementResult(
+                node_rows=choices[gi, : a.count], scores=scores[gi, : a.count]
+            )
+            for gi, a in enumerate(asks)
+        ]
+
+    def _place_scan_batch(self, cluster, asks: list) -> list[PlacementResult]:
+        pn = cluster.padded_n
         max_count = max(a.count for a in asks)
         max_steps = _steps_bucket(max(max_count, 1))
         max_v = max(a.num_spread_values for a in asks)
@@ -339,16 +557,8 @@ class PlacementKernel:
             out[: arr.shape[0]] = arr
             return out
 
-        batch = dict(
-            asks=np.stack([a.ask for a in asks]),
-            eligible=np.stack([a.eligible for a in asks]),
-            job_counts=np.stack([a.job_counts for a in asks]),
-            desired_totals=np.array(
-                [a.desired_total for a in asks], dtype=np.float32
-            ),
-            penalty_nodes=np.stack([a.penalty_nodes for a in asks]),
-            affinity_scores=np.stack([a.affinity_scores for a in asks]),
-            has_affinities=np.array([a.has_affinities for a in asks]),
+        batch = _shared_batch(asks, pn)
+        batch.update(
             spread_value_ids=np.stack([a.spread_value_ids for a in asks]),
             spread_desired=np.stack([pad_v(a.spread_desired) for a in asks]),
             spread_counts=np.stack(
@@ -358,16 +568,6 @@ class PlacementKernel:
                 [a.spread_weight for a in asks], dtype=np.float32
             ),
             has_spreads=np.array([a.has_spreads for a in asks]),
-            distinct_hosts=np.array([a.distinct_hosts for a in asks]),
-            slot_caps=np.stack(
-                [
-                    a.slot_caps
-                    if a.slot_caps is not None
-                    else np.full(pn, np.inf, dtype=np.float32)
-                    for a in asks
-                ]
-            ),
-            counts=np.array([a.count for a in asks], dtype=np.int32),
         )
         choices, scores, _used = place_batch_kernel(
             jnp.asarray(cluster.capacity),

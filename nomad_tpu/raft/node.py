@@ -302,6 +302,8 @@ class RaftNode:
     def _ticker(self) -> None:
         while not self._stop.wait(0.01):
             with self._mu:
+                if self._stop.is_set():  # shutdown raced our wait: the log
+                    return                # may already be closed
                 if self.state == LEADER:
                     continue
                 if time.monotonic() - self._last_contact < self._timeout:

@@ -139,25 +139,29 @@ class ClusterServer:
                 self._forward_clients[addr] = c
         return c.call(method, args)
 
-    # client-plane handlers: heartbeats and alloc pulls are served by any
-    # server against local state (node_endpoint.go allows stale reads for
-    # GetClientAllocs); status resurrection is a write and chases the leader
+    # client-plane handlers: alloc pulls are served by any server against
+    # local state (node_endpoint.go allows stale reads for GetClientAllocs);
+    # heartbeats must reach the LEADER's TTL timers — dead-node detection
+    # lives there (nomad/heartbeat.go is leader-only state) — so a follower
+    # forwards them like any write
     def _handle_heartbeat(self, args):
+        hops = args.pop("_hops", 0) if isinstance(args, dict) else 0
         node_id = args["node_id"]
+        if not self.server._leader:
+            addr = self.raft.leader_addr()
+            if hops < 3 and addr and addr != self.rpc.address:
+                return self._forward(
+                    addr, "Nomad.heartbeat",
+                    {"node_id": node_id, "_hops": hops + 1},
+                )
+            # no reachable leader: grant a local grace TTL so the client
+            # keeps retrying rather than declaring the cluster gone
+            return self.server.config.heartbeat_ttl
         node = self.server.store.node_by_id(node_id)
         if node is not None and node.status == "down":
-            try:
-                self.server.update_node_status(node_id, "ready")
-            except NotLeaderError as e:
-                addr = e.leader_addr or self.raft.leader_addr()
-                if addr and addr != self.rpc.address:
-                    self._forward(
-                        addr, "Nomad.update_node_status",
-                        {"node_id": node_id, "status": "ready"},
-                    )
-        if self.server._leader:
-            return self.server.heartbeater.heartbeat(node_id)
-        return self.server.config.heartbeat_ttl
+            # node recovered after missed TTLs (heartbeat.go resurrection)
+            self.server.update_node_status(node_id, "ready")
+        return self.server.heartbeater.heartbeat(node_id)
 
     def _handle_pull_allocs(self, args):
         allocs, index = self.server.pull_allocs(

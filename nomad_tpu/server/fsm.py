@@ -82,7 +82,13 @@ class FSM:
         # on every replica since it depends only on store state. (e.g. a
         # NODE_STATUS for a node that GC reaped between submit and apply.)
         try:
-            return handler(self, self.store, index, payload or {})
+            result = handler(self, self.store, index, payload or {})
+            # Some store ops no-op on rejection (csi_claim → False) without
+            # touching indexes; latest_index MUST advance for every applied
+            # entry or the next append desyncs from the log (bump is a max,
+            # so this is free when the applier already bumped).
+            self.store.bump_index(index)
+            return result
         except Exception as e:  # noqa: BLE001 — invariant, see above
             log.warning(
                 "fsm: applier %s rejected entry at index %d: %s",

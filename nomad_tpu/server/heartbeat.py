@@ -44,6 +44,14 @@ class NodeHeartbeater:
             self._deadlines[node_id] = time.monotonic() + self.ttl
         return self.ttl
 
+    def initialize_from_store(self) -> None:
+        """Seed a TTL timer for every live node — a freshly-elected leader
+        must detect clients that died during the failover window
+        (leader.go:318 initializeHeartbeatTimers)."""
+        for node in self.server.store.nodes():
+            if not node.terminal_status():
+                self.heartbeat(node.id)
+
     def untrack(self, node_id: str) -> None:
         with self._lock:
             self._deadlines.pop(node_id, None)

@@ -180,6 +180,7 @@ class Server:
         self.plan_apply_loop.start()
         self.eval_broker.set_enabled(True)
         self.blocked_evals.set_enabled(True)
+        self.heartbeater.initialize_from_store()
         self.heartbeater.start()
         self.deployment_watcher.start()
         self.drainer.start()

@@ -269,3 +269,50 @@ class TestDurableSingleServer:
             assert srv2.store.latest_index == idx
         finally:
             srv2.raft.close()
+
+
+class TestHeartbeatForwarding:
+    def test_follower_heartbeats_reach_leader_timers(self, tmp_path):
+        """Dead-node detection lives in the LEADER's TTL map; a heartbeat
+        landing on a follower must be forwarded there (nomad/heartbeat.go
+        is leader-only; node_endpoint forwards)."""
+        rpcs = [RPCServer() for _ in range(3)]
+        for r in rpcs:
+            r.start()
+        ids = [f"s{i}" for i in range(3)]
+        peers = {ids[i]: rpcs[i].address for i in range(3)}
+        servers = [
+            ClusterServer(
+                ids[i], peers, rpcs[i],
+                data_dir=str(tmp_path / ids[i]),
+                server_config=ServerConfig(num_workers=0, heartbeat_ttl=2.0),
+                **FAST,
+            )
+            for i in range(3)
+        ]
+        for s in servers:
+            s.start()
+        try:
+            leader = wait_until(
+                lambda: next(
+                    (s for s in servers if s.raft.is_leader()), None
+                ),
+                msg="leader",
+            )
+            wait_until(lambda: leader.server._leader, msg="services")
+            follower = next(s for s in servers if s is not leader)
+            node = mock.node()
+            leader.server.register_node(node)
+            from nomad_tpu.rpc import RPCClient
+
+            c = RPCClient(follower.rpc.address)
+            ttl = c.call("Nomad.heartbeat", {"node_id": node.id})
+            assert ttl == 2.0
+            # the LEADER's heartbeater tracks the node now
+            assert node.id in leader.server.heartbeater._deadlines
+            c.close()
+        finally:
+            for s in servers:
+                s.shutdown()
+            for r in rpcs:
+                r.stop()
