@@ -54,14 +54,16 @@ class NomadClient:
                 msg = str(e)
             raise APIException(e.code, msg) from None
 
-    def get(self, path, **params):
-        return self._request("GET", path, params=params or None)
+    def get(self, url_path, **params):
+        return self._request("GET", url_path, params=params or None)
 
-    def post(self, path, body=None, **params):
-        return self._request("POST", path, body=body, params=params or None)
+    def post(self, url_path, body=None, **params):
+        return self._request(
+            "POST", url_path, body=body, params=params or None
+        )
 
-    def delete(self, path, **params):
-        return self._request("DELETE", path, params=params or None)
+    def delete(self, url_path, **params):
+        return self._request("DELETE", url_path, params=params or None)
 
     # -- nouns -------------------------------------------------------------
     @property
@@ -180,6 +182,56 @@ class Allocations:
 
     def info(self, alloc_id: str):
         return self.c.get(f"/v1/allocation/{alloc_id}")
+
+    def fs_ls(self, alloc_id: str, fs_path: str = "/"):
+        return self.c.get(
+            f"/v1/client/fs/ls/{alloc_id}", **{"path": fs_path}
+        )
+
+    def fs_cat(self, alloc_id: str, fs_path: str, offset: int = 0,
+               limit: int = 1 << 20):
+        return self.c.get(
+            f"/v1/client/fs/cat/{alloc_id}",
+            **{"path": fs_path, "offset": offset, "limit": limit},
+        )["data"]
+
+    def logs(self, alloc_id: str, task: str, type: str = "stdout",
+             follow: bool = False, offset: int = 0):
+        """Iterate log frames ({'offset': n, 'data': str}); with
+        ``follow`` streams until the connection closes (api/fs.go Logs)."""
+        import urllib.error
+        import urllib.request
+        from urllib.parse import urlencode
+
+        params = urlencode({
+            "task": task, "type": type,
+            "follow": "true" if follow else "false", "offset": offset,
+        })
+        url = (
+            f"{self.c.address}/v1/client/fs/logs/{alloc_id}?{params}"
+        )
+        req = urllib.request.Request(url)
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=None if follow else self.c.timeout
+            )
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            raise APIException(e.code, msg) from None
+
+        def gen():
+            import json as _json
+
+            with resp:
+                for line in resp:
+                    line = line.strip()
+                    if line:
+                        yield _json.loads(line)
+
+        return gen()
 
 
 class Evaluations:

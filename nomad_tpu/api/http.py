@@ -129,6 +129,18 @@ class HTTPAgent:
             ),
             (re.compile(r"^/v1/event/stream$"), self.handle_event_stream),
             (
+                re.compile(r"^/v1/client/fs/ls/(?P<alloc_id>[^/]+)$"),
+                self.handle_fs_ls,
+            ),
+            (
+                re.compile(r"^/v1/client/fs/cat/(?P<alloc_id>[^/]+)$"),
+                self.handle_fs_cat,
+            ),
+            (
+                re.compile(r"^/v1/client/fs/logs/(?P<alloc_id>[^/]+)$"),
+                self.handle_fs_logs,
+            ),
+            (
                 re.compile(r"^/v1/operator/snapshot/save$"),
                 self.handle_snapshot_save,
             ),
@@ -752,6 +764,89 @@ class HTTPAgent:
         if child is None:
             raise APIError(400, "launch skipped (prohibit_overlap)")
         return {"launched_job_id": child.id}
+
+    # -- client fs/logs proxy (command/agent/fs_endpoint.go) ---------------
+    def _client_rpc_for_alloc(self, alloc_id, query):
+        """Resolve alloc → node → the client's advertised RPC address
+        (client/fs_endpoint.go reachability via node attribute)."""
+        from ..client.endpoints import ATTR_RPC_ADDR
+        from ..rpc import RPCClient
+
+        alloc = self.server.store.alloc_by_id(alloc_id)
+        if alloc is None:
+            matches = [
+                x for x in self.server.store.allocs()
+                if x.id.startswith(alloc_id)
+            ]
+            if len(matches) != 1:
+                raise APIError(404, f"alloc not found: {alloc_id}")
+            alloc = matches[0]
+        self._enforce_obj_ns(query, alloc.namespace, "read-fs")
+        node = self.server.store.node_by_id(alloc.node_id)
+        addr = (node.attributes or {}).get(ATTR_RPC_ADDR) if node else None
+        if not addr:
+            raise APIError(
+                404, f"node for alloc {alloc.id[:8]} has no client RPC"
+            )
+        return RPCClient(addr), alloc
+
+    def handle_fs_ls(self, method, body, query, alloc_id):
+        if method != "GET":
+            raise APIError(405, "method not allowed")
+        c, alloc = self._client_rpc_for_alloc(alloc_id, query)
+        try:
+            return c.call(
+                "FS.list",
+                {"alloc_id": alloc.id, "path": query.get("path", "/")},
+            )
+        finally:
+            c.close()
+
+    def handle_fs_cat(self, method, body, query, alloc_id):
+        if method != "GET":
+            raise APIError(405, "method not allowed")
+        c, alloc = self._client_rpc_for_alloc(alloc_id, query)
+        try:
+            data = c.call(
+                "FS.read",
+                {
+                    "alloc_id": alloc.id,
+                    "path": query.get("path", "/"),
+                    "offset": int(query.get("offset", 0)),
+                    "limit": int(query.get("limit", 1 << 20)),
+                },
+            )
+            return {"data": data.decode("utf-8", "replace")}
+        finally:
+            c.close()
+
+    def handle_fs_logs(self, method, body, query, alloc_id):
+        if method != "GET":
+            raise APIError(405, "method not allowed")
+        task = query.get("task")
+        if not task:
+            raise APIError(400, "task parameter required")
+        c, alloc = self._client_rpc_for_alloc(alloc_id, query)
+        follow = query.get("follow", "") in ("true", "1")
+
+        def gen():
+            try:
+                for chunk in c.stream(
+                    "FS.logs",
+                    {
+                        "alloc_id": alloc.id,
+                        "task": task,
+                        "type": query.get("type", "stdout"),
+                        "follow": follow,
+                        "offset": int(query.get("offset", 0)),
+                    },
+                    timeout=3600 if follow else 30,
+                ):
+                    yield json.dumps(chunk)  # NDJSON frames
+            finally:
+                c.close()
+
+        return StreamingResponse(gen())
 
     def handle_event_stream(self, method, body, query):
         """NDJSON event stream (http.go:359 /v1/event/stream). Events are

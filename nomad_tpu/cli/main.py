@@ -253,6 +253,58 @@ def cmd_alloc_status(args) -> int:
     return 0
 
 
+def cmd_alloc_logs(args) -> int:
+    """nomad alloc logs [-stderr] [-f] <alloc_id> [task]
+    (command/alloc_logs.go)."""
+    c = _client(args)
+    try:
+        info = c.allocations.info(args.alloc_id)
+    except APIException as e:
+        return _fail(str(e))
+    task = args.task
+    if not task:
+        tasks = list((info.get("task_states") or {}).keys())
+        if len(tasks) == 1:
+            task = tasks[0]
+        elif not tasks:
+            return _fail("allocation has no tasks with state yet; pass a task name")
+        else:
+            return _fail(f"allocation has multiple tasks, pick one: {tasks}")
+    kind = "stderr" if args.stderr else "stdout"
+    try:
+        for frame in c.allocations.logs(
+            info["id"], task, type=kind, follow=args.follow,
+            offset=-args.tail if args.tail else 0,  # negative = tail
+        ):
+            print(frame["data"], end="")
+    except KeyboardInterrupt:
+        pass
+    except APIException as e:
+        return _fail(str(e))
+    return 0
+
+
+def cmd_alloc_fs(args) -> int:
+    """nomad alloc fs <alloc_id> [path] (command/alloc_fs.go): ls for
+    directories, cat for files."""
+    c = _client(args)
+    try:
+        info = c.allocations.info(args.alloc_id)
+        path = args.path or "/"
+        import json as _json
+
+        try:
+            entries = c.allocations.fs_ls(info["id"], path)
+            for e in entries:
+                kind = "d" if e["is_dir"] else "-"
+                print(f"{kind} {e['size']:>10}  {e['name']}")
+        except APIException:
+            print(c.allocations.fs_cat(info["id"], path), end="")
+    except APIException as e:
+        return _fail(str(e))
+    return 0
+
+
 def cmd_eval_status(args) -> int:
     c = _client(args)
     try:
@@ -448,6 +500,17 @@ def build_parser() -> argparse.ArgumentParser:
     alloc = sub.add_parser("alloc", help="alloc commands").add_subparsers(
         dest="sub", required=True
     )
+    alogs = alloc.add_parser("logs")
+    alogs.add_argument("alloc_id")
+    alogs.add_argument("task", nargs="?", default=None)
+    alogs.add_argument("-stderr", dest="stderr", action="store_true")
+    alogs.add_argument("-f", dest="follow", action="store_true")
+    alogs.add_argument("-tail", dest="tail", type=int, default=0)
+    alogs.set_defaults(fn=cmd_alloc_logs)
+    afs = alloc.add_parser("fs")
+    afs.add_argument("alloc_id")
+    afs.add_argument("path", nargs="?", default="/")
+    afs.set_defaults(fn=cmd_alloc_fs)
     astatus = alloc.add_parser("status")
     astatus.add_argument("alloc_id")
     astatus.set_defaults(fn=cmd_alloc_status)

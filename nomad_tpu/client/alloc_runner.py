@@ -34,11 +34,16 @@ class AllocRunner:
         drivers: dict,
         data_dir: str,
         on_update: Optional[Callable[[Allocation, str, dict], None]] = None,
+        restored_handles: Optional[dict] = None,
+        on_handle: Optional[Callable] = None,
     ):
         self.alloc = alloc
         self.drivers = drivers
         self.alloc_dir = os.path.join(data_dir, "allocs", alloc.id)
         self.on_update = on_update
+        # task_name → recovered TaskHandle (client restart re-attach)
+        self.restored_handles = restored_handles or {}
+        self.on_handle = on_handle
         self.task_runners: dict[str, TaskRunner] = {}
         self.task_states: dict[str, TaskState] = {}
         self._lock = threading.Lock()
@@ -75,6 +80,11 @@ class AllocRunner:
                 env=env,
                 restart_policy=tg.restart_policy,
                 on_state_change=self._on_task_state,
+                attach_handle=self.restored_handles.get(task.name),
+                on_handle=(
+                    (lambda name, h: self.on_handle(self.alloc.id, name, h))
+                    if self.on_handle is not None else None
+                ),
             )
             self.task_runners[task.name] = tr
         for tr in self.task_runners.values():

@@ -26,6 +26,7 @@ from ..structs import (
 from .alloc_runner import AllocRunner
 from .drivers import builtin_drivers
 from .fingerprint import fingerprint_node
+from .state import ClientStateDB
 
 log = logging.getLogger("nomad_tpu.client")
 
@@ -52,9 +53,13 @@ class Client:
         node: Optional[Node] = None,
         heartbeat_interval: Optional[float] = None,
         host_volumes: Optional[dict] = None,
+        serve_endpoints: bool = True,
     ):
         self.rpc = rpc
         self.data_dir = data_dir
+        self._serve_endpoints = serve_endpoints
+        self.endpoints = None
+        self.state_db = ClientStateDB(data_dir)
         self.drivers = builtin_drivers()
         self.node = fingerprint_node(node, data_dir=data_dir, drivers=self.drivers)
         if host_volumes:
@@ -73,6 +78,15 @@ class Client:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.node.status = "ready"
+        if self._serve_endpoints:
+            from .endpoints import ATTR_RPC_ADDR, ClientEndpoints
+
+            self.endpoints = ClientEndpoints(self)
+            addr = self.endpoints.start()
+            # advertised BEFORE registration so fs/logs proxying can reach
+            # this node (client/fs_endpoint.go reachability)
+            self.node.attributes[ATTR_RPC_ADDR] = addr
+        self._restore()
         self.rpc.register_node(self.node)
         for fn, name in (
             (self._heartbeat_loop, "heartbeat"),
@@ -83,12 +97,46 @@ class Client:
             t.start()
             self._threads.append(t)
 
-    def shutdown(self) -> None:
+    def shutdown(self, halt_tasks: bool = True) -> None:
+        """``halt_tasks=False`` leaves task processes running for a
+        restart to re-attach to (the client-restart upgrade path the
+        persistent state exists for)."""
         self._stop.set()
-        for r in list(self.runners.values()):
-            r.stop()
+        if halt_tasks:
+            for r in list(self.runners.values()):
+                r.stop()
         for t in self._threads:
             t.join(timeout=2)
+        if self.endpoints is not None:
+            self.endpoints.stop()
+        self.state_db.close()
+
+    # -- restore (client/state StateDB; task_runner.go:488-519) -----------
+    def _restore(self) -> None:
+        for alloc in self.state_db.allocs():
+            if alloc.terminal_status() or alloc.desired_status != ALLOC_DESIRED_RUN:
+                self.state_db.delete_alloc(alloc.id)
+                continue
+            handles = self.state_db.handles_for(alloc.id)
+            recovered = {}
+            for name, h in handles.items():
+                driver = self.drivers.get(h.driver)
+                if driver is not None and driver.recover(h):
+                    recovered[name] = h
+                    log.info(
+                        "restored task %s/%s (pid %s)", alloc.id[:8], name, h.pid
+                    )
+            runner = AllocRunner(
+                alloc, self.drivers, self.data_dir,
+                on_update=self._on_alloc_update,
+                restored_handles=recovered,
+                on_handle=self.state_db.put_handle,
+            )
+            with self._lock:
+                self.runners[alloc.id] = runner
+            threading.Thread(
+                target=runner.run, name=f"alloc-{alloc.id[:8]}", daemon=True
+            ).start()
 
     # -- heartbeats --------------------------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -126,6 +174,7 @@ class Client:
             a = desired.get(alloc_id)
             if a is None:
                 runner.destroy()
+                self.state_db.delete_alloc(alloc_id)
                 with self._lock:
                     self.runners.pop(alloc_id, None)
             elif a.desired_status in (ALLOC_DESIRED_STOP, "evict"):
@@ -137,8 +186,11 @@ class Client:
                 continue
             if a.terminal_status() or alloc_id in running:
                 continue
+            self.state_db.put_alloc(a)
             runner = AllocRunner(
-                a, self.drivers, self.data_dir, on_update=self._on_alloc_update
+                a, self.drivers, self.data_dir,
+                on_update=self._on_alloc_update,
+                on_handle=self.state_db.put_handle,
             )
             with self._lock:
                 self.runners[alloc_id] = runner
@@ -156,6 +208,9 @@ class Client:
         }
         with self._lock:
             self._pending_updates[alloc.id] = upd
+        # keep the durable copy's status current so a restart doesn't
+        # re-run an already-finished alloc
+        self.state_db.put_alloc(upd)
 
     def _sync_loop(self) -> None:
         while not self._stop.is_set():

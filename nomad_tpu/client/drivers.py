@@ -27,6 +27,18 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
+def _proc_start_time(pid: int):
+    """Kernel start time (clock ticks since boot) from /proc — the
+    identity that distinguishes a live task from a recycled PID."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        # field 22, counting from 1 after the parenthesized comm
+        return int(data.rsplit(b")", 1)[1].split()[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 TASK_STATE_PENDING = "pending"
 TASK_STATE_RUNNING = "running"
 TASK_STATE_DEAD = "dead"
@@ -68,6 +80,13 @@ class TaskDriver:
 
     def inspect(self, handle: TaskHandle) -> TaskHandle:
         return handle
+
+    def recover(self, handle: TaskHandle) -> bool:
+        """Re-attach to a task that survived a client restart
+        (plugins/drivers/task_handle.go reattach tokens; client/state
+        restore path task_runner.go:488-519). Returns False when the task
+        cannot be recovered (caller restarts it per policy)."""
+        return False
 
 
 class MockDriver(TaskDriver):
@@ -145,12 +164,45 @@ class RawExecDriver(TaskDriver):
             stdout.close()
             stderr.close()
         h = TaskHandle(id=str(uuid.uuid4()), driver=self.name, pid=proc.pid)
+        h.meta["proc_start"] = _proc_start_time(proc.pid)
         self._procs[h.id] = proc
         return h
+
+    def recover(self, handle: TaskHandle) -> bool:
+        """Re-attach by pid + kernel start time: a recycled PID must not
+        re-attach to (and later SIGTERM) an unrelated process. (The
+        reference re-attaches to its executor subprocess, which owns the
+        child and its eventual exit status; without an owning process a
+        recovered task's exit code is unobservable and reads as 0.)"""
+        if handle.pid <= 0:
+            return False
+        try:
+            os.kill(handle.pid, 0)
+        except (ProcessLookupError, PermissionError):
+            return False
+        want = handle.meta.get("proc_start")
+        if want is not None and _proc_start_time(handle.pid) != want:
+            return False  # same pid, different process: recycled
+        handle.meta["recovered"] = True
+        return True
 
     def wait(self, handle, timeout=None):
         proc = self._procs.get(handle.id)
         if proc is None:
+            if handle.meta.get("recovered") and handle.pid > 0:
+                # not our child: poll for process-group exit
+                deadline = None if timeout is None else time.time() + timeout
+                while True:
+                    try:
+                        os.kill(handle.pid, 0)
+                    except ProcessLookupError:
+                        handle.state = TASK_STATE_DEAD
+                        handle.exit_code = 0  # unobservable post-reattach
+                        handle.completed_at = time.time()
+                        return 0
+                    if deadline is not None and time.time() >= deadline:
+                        return None
+                    time.sleep(0.1)
             return handle.exit_code
         try:
             code = proc.wait(timeout=timeout)
@@ -163,7 +215,14 @@ class RawExecDriver(TaskDriver):
 
     def stop(self, handle, kill_timeout=5.0):
         proc = self._procs.get(handle.id)
-        if proc is None or proc.poll() is not None:
+        if proc is None:
+            if handle.meta.get("recovered") and handle.pid > 0:
+                try:
+                    os.killpg(handle.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            return
+        if proc.poll() is not None:
             return
         try:
             os.killpg(proc.pid, signal.SIGTERM)

@@ -61,6 +61,8 @@ class TaskRunner:
         env: dict[str, str],
         restart_policy: Optional[RestartPolicy] = None,
         on_state_change=None,
+        attach_handle: Optional[TaskHandle] = None,
+        on_handle=None,
     ):
         self.task = task
         self.driver = driver
@@ -70,6 +72,10 @@ class TaskRunner:
         self.state = TaskState()
         self.handle: Optional[TaskHandle] = None
         self.on_state_change = on_state_change
+        # restore path (task_runner.go:488-519): a persisted handle the
+        # driver successfully recovered — skip the first driver.start
+        self.attach_handle = attach_handle
+        self.on_handle = on_handle  # persists handles for restart restore
         self._kill = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._restart_times: list[float] = []
@@ -97,17 +103,23 @@ class TaskRunner:
     def run(self) -> None:
         os.makedirs(self.task_dir, exist_ok=True)
         while not self._kill.is_set():
-            try:
-                self.handle = self.driver.start(
-                    self.task, self._task_env(), self.task_dir
-                )
-            except DriverError as e:
-                self.state.record(
-                    TaskEvent(TASK_EVENT_DRIVER_ERROR, message=str(e))
-                )
-                if not self._should_restart(failed=True):
-                    break
-                continue
+            if self.attach_handle is not None:
+                self.handle = self.attach_handle
+                self.attach_handle = None  # restarts go through start()
+            else:
+                try:
+                    self.handle = self.driver.start(
+                        self.task, self._task_env(), self.task_dir
+                    )
+                except DriverError as e:
+                    self.state.record(
+                        TaskEvent(TASK_EVENT_DRIVER_ERROR, message=str(e))
+                    )
+                    if not self._should_restart(failed=True):
+                        break
+                    continue
+            if self.on_handle is not None and self.handle is not None:
+                self.on_handle(self.task.name, self.handle)
 
             self.state.state = "running"
             self.state.started_at = self.state.started_at or time.time()

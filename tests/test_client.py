@@ -3,6 +3,7 @@ loop (job → scheduler → client pull → task execution → status sync back)
 Mirrors the reference's client test strategy (mock driver + real hook
 pipelines against temp dirs, SURVEY.md §4.5)."""
 
+import json
 import os
 import time
 
@@ -186,3 +187,270 @@ class TestDevAgent:
             ),
             timeout=15,
         )
+
+
+class TestClientRestore:
+    """client/state StateDB analog: restart re-attaches to live tasks
+    (task_runner.go:488-519 restore; handles persisted via the native WAL
+    KV)."""
+
+    def _server(self):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_workers=1))
+        srv.establish_leadership()
+        return srv
+
+    def test_restart_reattaches_to_live_process(self, tmp_path):
+        import time
+
+        from nomad_tpu.client.client import Client
+
+        srv = self._server()
+        cdir = str(tmp_path / "client")
+        client = Client(
+            srv.client_rpc(), data_dir=cdir, heartbeat_interval=0.2
+        )
+        client.start()
+        try:
+            job = mock.job()
+            job.task_groups[0].count = 1
+            t = job.task_groups[0].tasks[0]
+            t.driver = "raw_exec"
+            t.config = {"command": "/bin/sh", "args": ["-c", "sleep 60"]}
+            srv.register_job(job)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                allocs = srv.store.allocs_by_job("default", job.id)
+                if allocs and allocs[0].client_status == "running":
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("alloc never ran")
+            runner = next(iter(client.runners.values()))
+            pid = runner.task_runners[t.name].handle.pid
+            assert pid > 0
+
+            # simulate a client-process restart WITHOUT killing tasks
+            client.shutdown(halt_tasks=False)
+            import os
+
+            os.kill(pid, 0)  # the task survived the client going away
+
+            client2 = Client(
+                srv.client_rpc(), data_dir=cdir,
+                node=client.node, heartbeat_interval=0.2,
+            )
+            client2.start()
+            try:
+                deadline = time.time() + 5
+                while time.time() < deadline and not client2.runners:
+                    time.sleep(0.05)
+                assert client2.runners, "restore created no runners"
+                r2 = next(iter(client2.runners.values()))
+                deadline = time.time() + 5
+                while time.time() < deadline and not r2.task_runners:
+                    time.sleep(0.05)
+                h2 = r2.task_runners[t.name].handle
+                deadline = time.time() + 5
+                while time.time() < deadline and h2 is None:
+                    time.sleep(0.05)
+                    h2 = r2.task_runners[t.name].handle
+                assert h2 is not None and h2.pid == pid, (
+                    f"re-attached to wrong pid: {h2}"
+                )
+                assert h2.meta.get("recovered")
+                os.kill(pid, 0)  # still alive: restore did NOT restart it
+            finally:
+                client2.shutdown()  # halt_tasks=True kills the sleep
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    break
+                time.sleep(0.05)
+        finally:
+            srv.shutdown()
+
+    def test_completed_alloc_not_rerun_on_restore(self, tmp_path):
+        import time
+
+        from nomad_tpu.client.client import Client
+
+        srv = self._server()
+        cdir = str(tmp_path / "client")
+        marker = tmp_path / "ran-count"
+        client = Client(
+            srv.client_rpc(), data_dir=cdir, heartbeat_interval=0.2
+        )
+        client.start()
+        try:
+            job = mock.job(type="batch")
+            job.task_groups[0].count = 1
+            t = job.task_groups[0].tasks[0]
+            t.driver = "raw_exec"
+            t.config = {
+                "command": "/bin/sh",
+                "args": ["-c", f"echo run >> {marker}"],
+            }
+            srv.register_job(job)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                allocs = srv.store.allocs_by_job("default", job.id)
+                if allocs and allocs[0].client_status == "complete":
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("batch alloc never completed")
+            assert marker.read_text().count("run") == 1
+        finally:
+            client.shutdown(halt_tasks=False)
+        client2 = Client(
+            srv.client_rpc(), data_dir=cdir, heartbeat_interval=0.2
+        )
+        client2.start()
+        try:
+            time.sleep(1.0)
+            assert marker.read_text().count("run") == 1  # NOT re-run
+        finally:
+            client2.shutdown()
+            srv.shutdown()
+
+
+class TestFsLogs:
+    """fs/logs: client-served RPC endpoints proxied through the HTTP
+    agent (client/fs_endpoint.go + command/agent/fs_endpoint.go)."""
+
+    def test_logs_and_fs_through_http(self, tmp_path):
+        import time
+        import urllib.request
+
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.client.client import Client
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_workers=1))
+        srv.establish_leadership()
+        client = Client(
+            srv.client_rpc(), data_dir=str(tmp_path / "c"),
+            heartbeat_interval=0.2,
+        )
+        client.start()
+        http = HTTPAgent(srv, client, port=0)
+        http.start()
+        try:
+            job = mock.job(type="batch")
+            job.task_groups[0].count = 1
+            t = job.task_groups[0].tasks[0]
+            t.driver = "raw_exec"
+            t.config = {
+                "command": "/bin/sh",
+                "args": ["-c", "echo hello-stdout; echo hello-stderr 1>&2; echo data > out.txt"],
+            }
+            srv.register_job(job)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                allocs = srv.store.allocs_by_job("default", job.id)
+                if allocs and allocs[0].client_status == "complete":
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("batch job never completed")
+            alloc = allocs[0]
+            base = http.address
+
+            # fs ls at the task dir
+            with urllib.request.urlopen(
+                f"{base}/v1/client/fs/ls/{alloc.id}?path={t.name}"
+            ) as r:
+                names = {e["name"] for e in json.loads(r.read())}
+            assert "out.txt" in names
+            assert f"{t.name}.stdout" in names
+
+            # fs cat of a task-created file
+            with urllib.request.urlopen(
+                f"{base}/v1/client/fs/cat/{alloc.id}?path={t.name}/out.txt"
+            ) as r:
+                assert json.loads(r.read())["data"] == "data\n"
+
+            # logs: stdout and stderr streams
+            with urllib.request.urlopen(
+                f"{base}/v1/client/fs/logs/{alloc.id}?task={t.name}&type=stdout"
+            ) as r:
+                frames = [json.loads(l) for l in r.read().splitlines() if l]
+            assert "hello-stdout" in "".join(f["data"] for f in frames)
+            with urllib.request.urlopen(
+                f"{base}/v1/client/fs/logs/{alloc.id}?task={t.name}&type=stderr"
+            ) as r:
+                frames = [json.loads(l) for l in r.read().splitlines() if l]
+            assert "hello-stderr" in "".join(f["data"] for f in frames)
+
+            # path escape rejected
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"{base}/v1/client/fs/cat/{alloc.id}?path=../../../etc/passwd"
+                )
+        finally:
+            http.stop()
+            client.shutdown()
+            srv.shutdown()
+
+    def test_follow_streams_live_output(self, tmp_path):
+        import threading
+        import time
+
+        from nomad_tpu.api.client import NomadClient
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.client.client import Client
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_workers=1))
+        srv.establish_leadership()
+        client = Client(
+            srv.client_rpc(), data_dir=str(tmp_path / "c"),
+            heartbeat_interval=0.2,
+        )
+        client.start()
+        http = HTTPAgent(srv, client, port=0)
+        http.start()
+        try:
+            job = mock.job()
+            job.task_groups[0].count = 1
+            t = job.task_groups[0].tasks[0]
+            t.driver = "raw_exec"
+            t.config = {
+                "command": "/bin/sh",
+                "args": ["-c", "for i in 1 2 3; do echo tick-$i; sleep 0.3; done; sleep 30"],
+            }
+            srv.register_job(job)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                allocs = srv.store.allocs_by_job("default", job.id)
+                if allocs and allocs[0].client_status == "running":
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("job never ran")
+            c = NomadClient(http.address)
+            seen = []
+
+            def reader():
+                for frame in c.allocations.logs(
+                    allocs[0].id, t.name, follow=True
+                ):
+                    seen.append(frame["data"])
+                    if "tick-3" in "".join(seen):
+                        return
+
+            th = threading.Thread(target=reader, daemon=True)
+            th.start()
+            th.join(timeout=10)
+            joined = "".join(seen)
+            assert "tick-1" in joined and "tick-3" in joined
+        finally:
+            http.stop()
+            client.shutdown()
+            srv.shutdown()
