@@ -95,6 +95,21 @@ class NomadClient:
         return Agent(self)
 
     @property
+    def namespaces(self) -> "Namespaces":
+        return Namespaces(self)
+
+    @property
+    def scaling(self) -> "Scaling":
+        return Scaling(self)
+
+    def search(self, prefix: str, context: str = "all",
+               namespace: str = "default"):
+        return self.post(
+            "/v1/search", {"prefix": prefix, "context": context},
+            namespace=namespace,
+        )
+
+    @property
     def volumes(self) -> "Volumes":
         return Volumes(self)
 
@@ -140,6 +155,17 @@ class Jobs:
             },
             namespace=namespace,
         )
+
+    def scale(self, job_id: str, group: str, count: int,
+              message: str = "", namespace: str = "default"):
+        return self.c.post(
+            f"/v1/job/{job_id}/scale",
+            {"target": {"group": group}, "count": count, "message": message},
+            namespace=namespace,
+        )
+
+    def scale_status(self, job_id: str, namespace: str = "default"):
+        return self.c.get(f"/v1/job/{job_id}/scale", namespace=namespace)
 
     def periodic_force(self, job_id: str, namespace: str = "default"):
         return self.c.post(
@@ -313,3 +339,30 @@ class Agent:
 
     def metrics(self):
         return self.c.get("/v1/metrics")
+
+
+class Namespaces:
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def list(self):
+        return self.c.get("/v1/namespaces")
+
+    def info(self, name: str):
+        return self.c.get(f"/v1/namespace/{name}")
+
+    def apply(self, name: str, description: str = ""):
+        return self.c.post(
+            f"/v1/namespace/{name}", {"description": description}
+        )
+
+    def delete(self, name: str):
+        return self.c.delete(f"/v1/namespace/{name}")
+
+
+class Scaling:
+    def __init__(self, c: NomadClient):
+        self.c = c
+
+    def policies(self, namespace: str = "default"):
+        return self.c.get("/v1/scaling/policies", namespace=namespace)

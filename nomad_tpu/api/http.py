@@ -128,6 +128,21 @@ class HTTPAgent:
                 self.handle_periodic_force,
             ),
             (re.compile(r"^/v1/event/stream$"), self.handle_event_stream),
+            (re.compile(r"^/v1/namespaces$"), self.handle_namespaces),
+            (
+                re.compile(r"^/v1/namespace/(?P<name>[^/]+)$"),
+                self.handle_namespace,
+            ),
+            (re.compile(r"^/v1/namespace$"), self.handle_namespace_create),
+            (
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/scale$"),
+                self.handle_job_scale,
+            ),
+            (
+                re.compile(r"^/v1/scaling/policies$"),
+                self.handle_scaling_policies,
+            ),
+            (re.compile(r"^/v1/search$"), self.handle_search),
             (
                 re.compile(r"^/v1/client/fs/ls/(?P<alloc_id>[^/]+)$"),
                 self.handle_fs_ls,
@@ -764,6 +779,194 @@ class HTTPAgent:
         if child is None:
             raise APIError(400, "launch skipped (prohibit_overlap)")
         return {"launched_job_id": child.id}
+
+    # -- namespaces (namespace_endpoint.go) --------------------------------
+    def handle_namespaces(self, method, body, query):
+        if method != "GET":
+            raise APIError(405, "method not allowed")
+        acl = self._acl(query)
+        out = [
+            {
+                "name": n.name, "description": n.description,
+                "create_index": n.create_index,
+                "modify_index": n.modify_index,
+            }
+            for n in self.server.store.namespaces()
+        ]
+        # the default namespace always exists implicitly
+        if not any(n["name"] == "default" for n in out):
+            out.insert(0, {"name": "default",
+                           "description": "Default shared namespace",
+                           "create_index": 1, "modify_index": 1})
+        if acl is not None:  # List filters to visible namespaces
+            out = [n for n in out if acl.allow_namespace(n["name"])]
+        return sorted(out, key=lambda n: n["name"])
+
+    def handle_namespace(self, method, body, query, name):
+        from ..structs.job import Namespace
+
+        if method == "GET":
+            acl = self._acl(query)
+            if acl is not None and not acl.allow_namespace(name):
+                raise APIError(403, "Permission denied")
+            if name == "default":
+                return {"name": "default",
+                        "description": "Default shared namespace"}
+            ns = self.server.store.namespace_by_name(name)
+            if ns is None:
+                raise APIError(404, f"namespace not found: {name}")
+            return encode(ns)
+        if method in ("PUT", "POST"):
+            self._enforce_management(query)
+            ns = Namespace(
+                name=name,
+                description=(body or {}).get("description", ""),
+            )
+            try:
+                self.server.upsert_namespace(ns)
+            except ValueError as e:
+                raise APIError(400, str(e)) from None
+            return {"index": self.server.store.latest_index}
+        if method == "DELETE":
+            self._enforce_management(query)
+            try:
+                self.server.delete_namespace(name)
+            except KeyError as e:
+                raise APIError(404, str(e)) from None
+            except ValueError as e:
+                raise APIError(409, str(e)) from None
+            return {"index": self.server.store.latest_index}
+        raise APIError(405, "method not allowed")
+
+    def handle_namespace_create(self, method, body, query):
+        if method not in ("PUT", "POST"):
+            raise APIError(405, "PUT required")
+        name = (body or {}).get("name", "")
+        return self.handle_namespace("PUT", body, query, name)
+
+    # -- scaling (job_endpoint Scale + scaling_endpoint.go) -----------------
+    def handle_job_scale(self, method, body, query, job_id):
+        ns = query.get("namespace", "default")
+        if method == "GET":
+            self._enforce_ns(query, "read-job-scaling")
+            job = self.server.store.job_by_id(ns, job_id)
+            if job is None:
+                raise APIError(404, f"job not found: {job_id}")
+            return {
+                "job_id": job.id,
+                "namespace": job.namespace,
+                "job_stopped": job.stopped(),
+                "task_groups": {
+                    tg.name: {
+                        "desired": tg.count,
+                        "running": sum(
+                            1
+                            for a in self.server.store.allocs_by_job(ns, job.id)
+                            if a.task_group == tg.name
+                            and a.client_status == "running"
+                        ),
+                        "events": self.server.store.scaling_events(ns, job.id),
+                    }
+                    for tg in job.task_groups
+                },
+            }
+        if method in ("POST", "PUT"):
+            self._enforce_ns(query, "scale-job")
+            body = body or {}
+            target = body.get("target", {})
+            group = target.get("group") or target.get("Group")
+            count = body.get("count")
+            if not group or count is None:
+                raise APIError(400, "target.group and count required")
+            try:
+                ev = self.server.scale_job(
+                    ns, job_id, group, int(count),
+                    message=body.get("message", ""),
+                    error=bool(body.get("error", False)),
+                )
+            except KeyError as e:
+                raise APIError(404, str(e)) from None
+            except ValueError as e:
+                raise APIError(400, str(e)) from None
+            return {"eval_id": ev.id, "index": self.server.store.latest_index}
+        raise APIError(405, "method not allowed")
+
+    def handle_scaling_policies(self, method, body, query):
+        if method != "GET":
+            raise APIError(405, "method not allowed")
+        self._enforce_ns(query, "list-scaling-policies")
+        visible = self._ns_filter(query, "list-scaling-policies")
+        out = []
+        for job in self.server.store.jobs():
+            if not visible(job.namespace):
+                continue
+            for tg in job.task_groups:
+                if tg.scaling is not None:
+                    out.append(
+                        {
+                            "id": f"{job.namespace}/{job.id}/{tg.name}",
+                            "namespace": job.namespace,
+                            "job_id": job.id,
+                            "group": tg.name,
+                            "min": tg.scaling.min,
+                            "max": tg.scaling.max,
+                            "enabled": tg.scaling.enabled,
+                            "policy": tg.scaling.policy,
+                        }
+                    )
+        return out
+
+    # -- search (nomad/search_endpoint.go) ----------------------------------
+    SEARCH_CONTEXTS = ("jobs", "nodes", "allocs", "evals", "deployments",
+                       "volumes", "namespaces")
+    SEARCH_TRUNCATE = 20  # search_endpoint.go truncateLimit
+
+    def handle_search(self, method, body, query):
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        body = body or {}
+        prefix = body.get("prefix", "")
+        context = body.get("context", "all") or "all"
+        contexts = (
+            self.SEARCH_CONTEXTS if context == "all" else (context,)
+        )
+        ns = query.get("namespace", "default")
+        store = self.server.store
+        matches, truncations = {}, {}
+
+        def collect(name, ids):
+            hits = sorted(i for i in ids if i.startswith(prefix))
+            truncations[name] = len(hits) > self.SEARCH_TRUNCATE
+            matches[name] = hits[: self.SEARCH_TRUNCATE]
+
+        for ctx in contexts:
+            if ctx == "jobs":
+                self._enforce_ns(query, "read-job")
+                collect("jobs", [
+                    j.id for j in store.jobs() if j.namespace == ns
+                ])
+            elif ctx == "nodes":
+                collect("nodes", [n.id for n in store.nodes()])
+            elif ctx == "allocs":
+                collect("allocs", [
+                    a.id for a in store.allocs() if a.namespace == ns
+                ])
+            elif ctx == "evals":
+                collect("evals", [
+                    e.id for e in store.evals() if e.namespace == ns
+                ])
+            elif ctx == "deployments":
+                collect("deployments", [
+                    d.id for d in store.deployments() if d.namespace == ns
+                ])
+            elif ctx == "volumes":
+                collect("volumes", [v.id for v in store.csi_volumes()])
+            elif ctx == "namespaces":
+                names = [n.name for n in store.namespaces()] + ["default"]
+                collect("namespaces", names)
+            else:
+                raise APIError(400, f"invalid context {ctx!r}")
+        return {"matches": matches, "truncations": truncations}
 
     # -- client fs/logs proxy (command/agent/fs_endpoint.go) ---------------
     def _client_rpc_for_alloc(self, alloc_id, query):

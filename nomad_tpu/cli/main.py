@@ -444,6 +444,95 @@ def cmd_operator_scheduler(args) -> int:
     return 0
 
 
+def cmd_namespace(args) -> int:
+    c = _client(args)
+    try:
+        if args.ns_cmd == "list":
+            for n in c.namespaces.list():
+                print(f"{n['name']:<20} {n.get('description','')}")
+        elif args.ns_cmd == "apply":
+            c.namespaces.apply(args.name, args.description or "")
+            print(f"namespace {args.name!r} applied")
+        elif args.ns_cmd == "delete":
+            c.namespaces.delete(args.name)
+            print(f"namespace {args.name!r} deleted")
+        elif args.ns_cmd == "status":
+            print(json.dumps(c.namespaces.info(args.name), indent=2))
+    except APIException as e:
+        return _fail(str(e))
+    return 0
+
+
+def cmd_job_scale(args) -> int:
+    """nomad job scale <job> [group] <count> (command/job_scale.go)."""
+    sa = args.scale_args
+    if len(sa) == 2:
+        job_id, group, count_s = sa[0], None, sa[1]
+    elif len(sa) == 3:
+        job_id, group, count_s = sa
+    else:
+        return _fail("usage: job scale <job> [group] <count>")
+    try:
+        count = int(count_s)
+    except ValueError:
+        return _fail(f"count must be an integer, got {count_s!r}")
+    args.job_id, args.count = job_id, count
+    c = _client(args)
+    if group is None:
+        try:
+            info = c.jobs.info(args.job_id)
+        except APIException as e:
+            return _fail(str(e))
+        tgs = [tg["name"] for tg in info.get("task_groups", [])]
+        if len(tgs) != 1:
+            return _fail(f"job has multiple groups, pick one: {tgs}")
+        group = tgs[0]
+    try:
+        out = c.jobs.scale(args.job_id, group, args.count)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"==> scaled {args.job_id}/{group} to {args.count}; "
+          f"evaluation {out['eval_id']}")
+    return 0
+
+
+def cmd_status(args) -> int:
+    """nomad status <prefix>: cross-context search dispatch
+    (command/status.go + search_endpoint.go)."""
+    c = _client(args)
+    try:
+        if not args.prefix:
+            return cmd_job_status(argparse.Namespace(
+                address=args.address, job_id=None))
+        res = c.search(args.prefix)
+        hits = [(ctx, m) for ctx, ms in res["matches"].items() for m in ms]
+        if not hits:
+            return _fail(f"no matches for {args.prefix!r}")
+        if len(hits) > 1:
+            print(f"multiple matches for {args.prefix!r}:")
+            for ctx, m in hits:
+                print(f"  {ctx[:-1]:<12} {m}")
+            return 0
+        ctx, m = hits[0]
+        ns = argparse.Namespace(address=args.address)
+        if ctx == "jobs":
+            ns.job_id = m
+            return cmd_job_status(ns)
+        if ctx == "nodes":
+            ns.node_id = m
+            return cmd_node_status(ns)
+        if ctx == "allocs":
+            ns.alloc_id = m
+            return cmd_alloc_status(ns)
+        if ctx == "evals":
+            ns.eval_id = m
+            return cmd_eval_status(ns)
+        print(f"{ctx[:-1]}: {m}")
+    except APIException as e:
+        return _fail(str(e))
+    return 0
+
+
 def cmd_server_members(args) -> int:
     c = _client(args)
     info = c.agent.self()
@@ -454,7 +543,7 @@ def cmd_server_members(args) -> int:
 # -- parser -----------------------------------------------------------------
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu")
-    p.add_argument("--address", default=DEFAULT_ADDR)
+    p.add_argument("-address", "--address", default=DEFAULT_ADDR)
     sub = p.add_subparsers(dest="cmd", required=True)
 
     agent = sub.add_parser("agent", help="run an agent")
@@ -478,6 +567,10 @@ def build_parser() -> argparse.ArgumentParser:
     status = job.add_parser("status")
     status.add_argument("job_id", nargs="?")
     status.set_defaults(fn=cmd_job_status)
+    scale = job.add_parser("scale")
+    scale.add_argument("scale_args", nargs="+",
+                       metavar="job [group] count")
+    scale.set_defaults(fn=cmd_job_scale)
     stop = job.add_parser("stop")
     stop.add_argument("job_id")
     stop.set_defaults(fn=cmd_job_stop)
@@ -563,6 +656,26 @@ def build_parser() -> argparse.ArgumentParser:
     sched = op.add_parser("scheduler")
     sched.add_argument("--algorithm", choices=["binpack", "spread"])
     sched.set_defaults(fn=cmd_operator_scheduler)
+
+    nsp = sub.add_parser("namespace", help="namespace commands").add_subparsers(
+        dest="ns_cmd", required=True
+    )
+    nlist = nsp.add_parser("list")
+    nlist.set_defaults(fn=cmd_namespace)
+    napply = nsp.add_parser("apply")
+    napply.add_argument("name")
+    napply.add_argument("-description", default="")
+    napply.set_defaults(fn=cmd_namespace)
+    ndel = nsp.add_parser("delete")
+    ndel.add_argument("name")
+    ndel.set_defaults(fn=cmd_namespace)
+    nstat = nsp.add_parser("status")
+    nstat.add_argument("name")
+    nstat.set_defaults(fn=cmd_namespace)
+
+    st = sub.add_parser("status", help="search across objects")
+    st.add_argument("prefix", nargs="?", default="")
+    st.set_defaults(fn=cmd_status)
 
     server = sub.add_parser("server", help="server commands").add_subparsers(
         dest="sub", required=True

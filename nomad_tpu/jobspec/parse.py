@@ -417,6 +417,21 @@ def _parse_group(block: hcl.Block, ctx: hcl.EvalContext, job: Job) -> TaskGroup:
         )
     for nb in b.blocks_of("network"):
         tg.networks.append(_parse_network(nb.body, ctx))
+    sb = b.first("scaling")
+    if sb is not None:
+        from ..structs.job import ScalingPolicy
+
+        sa = _attrs(sb.body, ctx)
+        pol = {}
+        pb = sb.body.first("policy")
+        if pb is not None:
+            pol = _attrs(pb.body, ctx)
+        tg.scaling = ScalingPolicy(
+            min=int(sa.get("min", 0)),
+            max=int(sa.get("max", 0)),
+            enabled=bool(sa.get("enabled", True)),
+            policy=pol,
+        )
     _collect_cas(b, ctx, tg.constraints, tg.affinities, tg.spreads)
     tg.meta = _meta(b, ctx)
     for tb in b.blocks_of("task"):

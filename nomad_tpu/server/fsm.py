@@ -49,6 +49,9 @@ class MsgType(IntEnum):
     ACL_TOKEN_UPSERT = 22         # {tokens}
     ACL_TOKEN_DELETE = 23         # {accessor_ids}
     SCHED_CONFIG = 24             # {config}
+    NAMESPACE_UPSERT = 25         # {namespace}
+    NAMESPACE_DELETE = 26         # {name}
+    JOB_SCALE = 27                # {job, evals, event}
 
 
 class FSM:
@@ -238,6 +241,24 @@ def _apply_sched_config(fsm, store, index, p):
     store.set_scheduler_config(index, p["config"])
 
 
+def _apply_namespace_upsert(fsm, store, index, p):
+    store.upsert_namespace(index, p["namespace"])
+
+
+def _apply_namespace_delete(fsm, store, index, p):
+    store.delete_namespace(index, p["name"])
+
+
+def _apply_job_scale(fsm, store, index, p):
+    store.upsert_job(index, p["job"])
+    if p.get("evals"):
+        for ev in p["evals"]:
+            ev.job_modify_index = index
+        store.upsert_evals(index, p["evals"])
+    job = p["job"]
+    store.add_scaling_event(index, job.namespace, job.id, p["event"])
+
+
 _APPLIERS = {
     MsgType.NOOP: _apply_noop,
     MsgType.JOB_UPSERT: _apply_job_upsert,
@@ -264,4 +285,7 @@ _APPLIERS = {
     MsgType.ACL_TOKEN_UPSERT: _apply_acl_token_upsert,
     MsgType.ACL_TOKEN_DELETE: _apply_acl_token_delete,
     MsgType.SCHED_CONFIG: _apply_sched_config,
+    MsgType.NAMESPACE_UPSERT: _apply_namespace_upsert,
+    MsgType.NAMESPACE_DELETE: _apply_namespace_delete,
+    MsgType.JOB_SCALE: _apply_job_scale,
 }

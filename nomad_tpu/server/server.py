@@ -139,6 +139,64 @@ class Server:
         server._install_store(restore_snapshot(path))
         return server
 
+    # -- API: namespaces (nomad/namespace_endpoint.go) ---------------------
+    def upsert_namespace(self, ns) -> None:
+        if not ns.name or not ns.name.replace("-", "").replace("_", "").isalnum():
+            raise ValueError(f"invalid namespace name {ns.name!r}")
+        self.raft_apply_checked(
+            self._msg.NAMESPACE_UPSERT, {"namespace": ns}
+        )
+
+    def delete_namespace(self, name: str) -> None:
+        self.raft_apply_checked(self._msg.NAMESPACE_DELETE, {"name": name})
+
+    # -- API: scaling (nomad/job_endpoint.go Scale + scaling_endpoint.go) --
+    def scale_job(self, namespace: str, job_id: str, group: str,
+                  count: int, message: str = "", error: bool = False):
+        """Job.Scale: adjust one group's count (a new job version) and
+        record a scaling event; autoscalers drive this endpoint."""
+        import copy as _copy
+
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job not found: {job_id}")
+        tg = job.lookup_task_group(group)
+        if tg is None:
+            raise KeyError(f"group not found: {group}")
+        if tg.scaling is not None and tg.scaling.enabled:
+            if count < tg.scaling.min or (
+                tg.scaling.max and count > tg.scaling.max
+            ):
+                raise ValueError(
+                    f"count {count} outside scaling bounds "
+                    f"[{tg.scaling.min}, {tg.scaling.max}]"
+                )
+        scaled = _copy.deepcopy(job)
+        scaled.lookup_task_group(group).count = count
+        ev = Evaluation(
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=TRIGGER_JOB_REGISTER,
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+        )
+        event = {
+            "group": group, "count": count, "previous_count": tg.count,
+            "message": message, "error": error,
+        }
+        self.raft_apply(
+            self._msg.JOB_SCALE,
+            {"job": scaled, "evals": [ev], "event": event},
+        )
+        (ev,) = self._fresh_evals([ev])
+        self.eval_broker.enqueue(ev)
+        self._publish(
+            "Job", "JobScaled", job_id, namespace,
+            {"group": group, "count": count},
+        )
+        return ev
+
     def _commit_plan_result(self, result, eval_id, evals) -> int:
         index, _ = self.raft_apply(
             self._msg.PLAN_RESULT,

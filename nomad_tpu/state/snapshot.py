@@ -33,6 +33,8 @@ def save_snapshot(store, path: str) -> int:
         "acl_tokens": dict(snap._t.acl_tokens),
         "acl_bootstrap": snap._t.indexes.get("acl_bootstrap", 0),
         "csi_volumes": dict(snap._t.csi_volumes),
+        "namespaces": dict(snap._t.namespaces),
+        "scaling_events": dict(snap._t.scaling_events),
         "scheduler_config": snap._t.scheduler_config,
     }
     with open(path, "wb") as f:
@@ -77,6 +79,11 @@ def restore_snapshot(path: str):
             store._own("indexes")["acl_bootstrap"] = payload["acl_bootstrap"]
     for vol in payload.get("csi_volumes", {}).values():
         store.restore_csi_volume(vol)
+    for ns in payload.get("namespaces", {}).values():
+        store.restore_namespace(ns)
+    if payload.get("scaling_events"):
+        with store._lock:
+            store._own("scaling_events").update(payload["scaling_events"])
     store.set_scheduler_config(index, payload["scheduler_config"])
     store._latest_index = max(store._latest_index, payload["index"])
     return store

@@ -78,6 +78,8 @@ class _Tables:
         "acl_tokens",
         "acl_tokens_by_secret",
         "csi_volumes",
+        "namespaces",
+        "scaling_events",
         "indexes",
         "scheduler_config",
     )
@@ -97,6 +99,9 @@ class _Tables:
         self.acl_tokens: dict[str, object] = {}  # accessor_id → ACLToken
         self.acl_tokens_by_secret: dict[str, str] = {}  # secret → accessor
         self.csi_volumes: dict[str, object] = {}  # volume id → CSIVolume
+        self.namespaces: dict[str, object] = {}  # name → Namespace
+        # (ns, job_id) → tuple of scaling event dicts, newest first
+        self.scaling_events: dict[tuple[str, str], tuple] = {}
         self.indexes: dict[str, int] = {}
         self.scheduler_config: SchedulerConfiguration = SchedulerConfiguration()
 
@@ -115,6 +120,8 @@ class _Tables:
         "acl_tokens",
         "acl_tokens_by_secret",
         "csi_volumes",
+        "namespaces",
+        "scaling_events",
         "indexes",
     )
 
@@ -126,6 +133,16 @@ class StateSnapshot:
     def __init__(self, tables: _Tables, index: int):
         self._t = tables
         self.index = index
+
+    # -- namespaces --------------------------------------------------------
+    def namespace_by_name(self, name: str):
+        return self._t.namespaces.get(name)
+
+    def namespaces(self) -> list:
+        return list(self._t.namespaces.values())
+
+    def scaling_events(self, namespace: str, job_id: str) -> list:
+        return list(self._t.scaling_events.get((namespace, job_id), ()))
 
     # -- nodes ------------------------------------------------------------
     def node_by_id(self, node_id: str) -> Optional[Node]:
@@ -914,3 +931,52 @@ class StateStore(StateSnapshot):
         with self._lock:
             self._t.scheduler_config = cfg
             self._bump(index, "scheduler_config")
+
+    # -- namespaces (nomad/state namespace table) --------------------------
+    def upsert_namespace(self, index: int, ns) -> None:
+        with self._lock:
+            table = self._own("namespaces")
+            existing = table.get(ns.name)
+            ns.create_index = existing.create_index if existing else index
+            ns.modify_index = index
+            table[ns.name] = ns
+            self._bump(index, "namespaces")
+
+    def delete_namespace(self, index: int, name: str) -> None:
+        """Refuses deletion of a non-empty namespace (namespace_endpoint.go
+        DeleteNamespaces: namespaces with jobs cannot be removed)."""
+        with self._lock:
+            if name == "default":
+                raise ValueError("default namespace cannot be deleted")
+            if name not in self._t.namespaces:
+                raise KeyError(f"namespace not found: {name}")
+            in_use = [
+                jid for (jns, jid) in self._t.jobs if jns == name
+            ]
+            if in_use:
+                raise ValueError(
+                    f"namespace {name!r} has {len(in_use)} job(s); "
+                    "deregister them first"
+                )
+            table = self._own("namespaces")
+            del table[name]
+            self._bump(index, "namespaces")
+
+    def restore_namespace(self, ns) -> None:
+        with self._lock:
+            self._own("namespaces")[ns.name] = ns
+            self._latest_index = max(self._latest_index, ns.modify_index)
+
+    # -- scaling events (structs.JobScalingEvents) -------------------------
+    MAX_SCALING_EVENTS = 20
+
+    def add_scaling_event(self, index: int, namespace: str, job_id: str,
+                          event: dict) -> None:
+        with self._lock:
+            table = self._own("scaling_events")
+            key = (namespace, job_id)
+            event = {**event, "index": index}
+            table[key] = ((event,) + table.get(key, ()))[
+                : self.MAX_SCALING_EVENTS
+            ]
+            self._bump(index, "scaling_events")

@@ -186,6 +186,29 @@ class Task:
 
 
 @dataclass(slots=True)
+class ScalingPolicy:
+    """Horizontal group scaling bounds + autoscaler policy document.
+    Reference: structs.ScalingPolicy (nomad/structs/structs.go; jobspec
+    ``scaling`` block on a task group)."""
+
+    min: int = 0
+    max: int = 0
+    enabled: bool = True
+    # opaque autoscaler policy document (passed through verbatim)
+    policy: dict = field(default_factory=dict)
+
+
+@dataclass(slots=True)
+class Namespace:
+    """Reference: structs.Namespace (nomad/structs/namespace)."""
+
+    name: str = ""
+    description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass(slots=True)
 class TaskGroup:
     """A co-scheduled set of tasks; the unit of placement.
     Reference: structs.TaskGroup."""
@@ -206,6 +229,7 @@ class TaskGroup:
     meta: dict[str, str] = field(default_factory=dict)
     # volume name → structs.volumes.VolumeRequest (group volume blocks)
     volumes: dict[str, object] = field(default_factory=dict)
+    scaling: Optional[ScalingPolicy] = None
 
     def combined_resources(self) -> Resources:
         """Sum of task asks + ephemeral disk, the group's placement ask."""

@@ -1,0 +1,179 @@
+"""Namespaces, job scaling, and search — server endpoints + HTTP/SDK/CLI
+surface. References: nomad/namespace_endpoint.go, job_endpoint.go Scale,
+scaling_endpoint.go, search_endpoint.go."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.api.client import APIException, NomadClient
+from nomad_tpu.api.http import HTTPAgent
+from nomad_tpu.server.server import Server, ServerConfig
+from nomad_tpu.structs.job import Namespace, ScalingPolicy
+
+
+@pytest.fixture
+def harness():
+    srv = Server(ServerConfig(num_workers=1))
+    srv.establish_leadership()
+    srv.register_node(mock.node())
+    http = HTTPAgent(srv, port=0)
+    http.start()
+    c = NomadClient(http.address)
+    yield srv, c
+    http.stop()
+    srv.shutdown()
+
+
+def wait_allocs(srv, job, n, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        allocs = [
+            a for a in srv.store.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        ]
+        if len(allocs) == n:
+            return allocs
+        time.sleep(0.05)
+    raise AssertionError(
+        f"expected {n} live allocs, have "
+        f"{len(srv.store.allocs_by_job(job.namespace, job.id))}"
+    )
+
+
+class TestNamespaces:
+    def test_crud_and_default(self, harness):
+        srv, c = harness
+        names = {n["name"] for n in c.namespaces.list()}
+        assert names == {"default"}
+        c.namespaces.apply("prod", "production workloads")
+        assert {n["name"] for n in c.namespaces.list()} == {"default", "prod"}
+        info = c.namespaces.info("prod")
+        assert info["description"] == "production workloads"
+        c.namespaces.delete("prod")
+        assert {n["name"] for n in c.namespaces.list()} == {"default"}
+
+    def test_delete_nonempty_refused(self, harness):
+        srv, c = harness
+        c.namespaces.apply("busy")
+        job = mock.job(namespace="busy")
+        srv.register_job(job)
+        with pytest.raises(APIException) as e:
+            c.namespaces.delete("busy")
+        assert e.value.status == 409
+        with pytest.raises(APIException):
+            c.namespaces.delete("default")
+
+    def test_survives_snapshot_roundtrip(self, harness, tmp_path):
+        srv, c = harness
+        c.namespaces.apply("kept", "still here")
+        from nomad_tpu.state.snapshot import restore_snapshot, save_snapshot
+
+        path = str(tmp_path / "s.snap")
+        save_snapshot(srv.store, path)
+        restored = restore_snapshot(path)
+        assert restored.namespace_by_name("kept").description == "still here"
+
+
+class TestScaling:
+    def test_scale_up_and_down(self, harness):
+        srv, c = harness
+        job = mock.job()
+        job.task_groups[0].count = 2
+        srv.register_job(job)
+        wait_allocs(srv, job, 2)
+
+        out = c.jobs.scale(job.id, job.task_groups[0].name, 4)
+        assert out["eval_id"]
+        wait_allocs(srv, job, 4)
+        assert srv.store.job_by_id("default", job.id).task_groups[0].count == 4
+
+        c.jobs.scale(job.id, job.task_groups[0].name, 1)
+        wait_allocs(srv, job, 1)
+
+        status = c.jobs.scale_status(job.id)
+        tg = status["task_groups"][job.task_groups[0].name]
+        assert tg["desired"] == 1
+        counts = [e["count"] for e in tg["events"]]
+        assert counts == [1, 4]  # newest first
+
+    def test_scaling_policy_bounds_enforced(self, harness):
+        srv, c = harness
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.task_groups[0].scaling = ScalingPolicy(min=1, max=3)
+        srv.register_job(job)
+        with pytest.raises(APIException) as e:
+            c.jobs.scale(job.id, job.task_groups[0].name, 10)
+        assert e.value.status == 400
+        with pytest.raises(APIException):
+            c.jobs.scale(job.id, job.task_groups[0].name, 0)
+        c.jobs.scale(job.id, job.task_groups[0].name, 3)  # in bounds
+
+    def test_scaling_policies_listed(self, harness):
+        srv, c = harness
+        job = mock.job()
+        job.task_groups[0].scaling = ScalingPolicy(
+            min=1, max=5, policy={"cooldown": "1m"}
+        )
+        srv.register_job(job)
+        pols = c.scaling.policies()
+        assert len(pols) == 1
+        assert pols[0]["job_id"] == job.id
+        assert pols[0]["max"] == 5
+        assert pols[0]["policy"] == {"cooldown": "1m"}
+
+    def test_jobspec_scaling_block(self):
+        from nomad_tpu.jobspec import parse_job_file
+
+        job = parse_job_file('''
+job "web" {
+  group "app" {
+    count = 2
+    scaling {
+      min     = 1
+      max     = 10
+      enabled = true
+      policy {
+        cooldown = "2m"
+      }
+    }
+    task "srv" {
+      driver = "mock_driver"
+    }
+  }
+}
+''')
+        sc = job.task_groups[0].scaling
+        assert sc is not None and (sc.min, sc.max) == (1, 10)
+        assert sc.policy.get("cooldown") == "2m"
+
+
+class TestSearch:
+    def test_prefix_search_contexts(self, harness):
+        srv, c = harness
+        job = mock.job()
+        job.task_groups[0].count = 3  # one mock node's worth
+        srv.register_job(job)
+        wait_allocs(srv, job, 3)
+
+        res = c.search(job.id[:5])
+        assert job.id in res["matches"]["jobs"]
+        node_id = next(iter(srv.store.nodes())).id
+        res = c.search(node_id[:8], context="nodes")
+        assert any(m.startswith(node_id[:8]) for m in res["matches"]["nodes"])
+        alloc = srv.store.allocs_by_job("default", job.id)[0]
+        res = c.search(alloc.id[:8], context="allocs")
+        assert alloc.id in res["matches"]["allocs"]
+        res = c.search("zzz-no-such")
+        assert not any(res["matches"].values())
+
+    def test_truncation(self, harness):
+        srv, c = harness
+        for i in range(25):
+            srv.register_node(mock.node(name=f"trunc-{i}"))
+        # node ids are uuids; search with empty prefix matches all
+        res = c.search("", context="nodes")
+        assert len(res["matches"]["nodes"]) == 20
+        assert res["truncations"]["nodes"] is True
