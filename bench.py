@@ -32,30 +32,12 @@ def _ensure_live_backend(timeout_s: float = 90.0) -> None:
     process on the CPU backend so the driver always gets its JSON line."""
     if os.environ.get("NOMAD_TPU_BENCH_FALLBACK"):
         return
-    import threading
+    from nomad_tpu.utils.backend import cpu_fallback_env, probe_device_count
 
-    ok: list[bool] = []
-
-    def probe():
-        try:
-            import jax
-
-            jax.devices()
-            ok.append(True)
-        except Exception:
-            pass
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if ok:
+    if probe_device_count(timeout_s) > 0:
         return
-    env = dict(os.environ)
+    env = cpu_fallback_env()
     env["NOMAD_TPU_BENCH_FALLBACK"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = ":".join(
-        p for p in env.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
-    )
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
 
 

@@ -1,0 +1,54 @@
+"""Backend liveness probe + CPU-fallback env construction.
+
+The axon TPU plugin can hang ``jax.devices()`` indefinitely when its
+tunnel is down, and jax latches its platform at first init — so a process
+that needs a different backend (or a virtual multi-device CPU mesh) must
+decide *before* touching jax, or delegate to a child process with the
+right env. Both bench.py and __graft_entry__.dryrun_multichip share this
+hazard; this module is the single copy of the workaround.
+"""
+
+import os
+import threading
+
+
+def probe_device_count(timeout_s: float = 90.0) -> int:
+    """Return ``len(jax.devices())``, or 0 if init fails or hangs past
+    ``timeout_s`` (probe runs in a daemon thread so a hung PJRT plugin
+    cannot wedge the caller)."""
+    found: list[int] = []
+
+    def probe():
+        try:
+            import jax
+
+            found.append(len(jax.devices()))
+        except Exception:
+            found.append(0)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return found[0] if found else 0
+
+
+def cpu_fallback_env(n_devices: int | None = None) -> dict:
+    """A copy of os.environ steered to the CPU backend: JAX_PLATFORMS=cpu,
+    the axon sitecustomize stripped from PYTHONPATH, and (optionally) a
+    virtual ``n_devices``-device host platform via XLA_FLAGS."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
+    )
+    if n_devices is not None:
+        flags = env.get("XLA_FLAGS", "")
+        flags = " ".join(
+            f
+            for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    return env

@@ -1,0 +1,147 @@
+"""Multi-chip sharding tests: the placement kernels under a real
+``jax.sharding.Mesh`` (8 virtual CPU devices via conftest) must produce
+bit-identical results to the single-device run.
+
+Production layout (SURVEY.md §2.7): node axis model-parallel over ICI,
+group/eval axis data-parallel; per-step argmax/top-k is the cross-shard
+reduction. This is the sharding the driver's dryrun_multichip validates;
+these tests pin its numerical equivalence.
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft
+from nomad_tpu.device.score import (
+    place_batch_kernel,
+    place_closed_form_kernel,
+    score_matrix_kernel,
+)
+
+
+def _mesh(dp=2, mp=4):
+    devices = np.array(jax.devices()[: dp * mp]).reshape(dp, mp)
+    return Mesh(devices, ("groups", "nodes"))
+
+
+def _shard(batch, mesh, specs):
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in batch.items()
+    }
+
+
+SPECS = dict(
+    capacity=P("nodes", None),
+    used0=P("nodes", None),
+    asks=P("groups", None),
+    eligible=P("groups", "nodes"),
+    job_counts=P("groups", "nodes"),
+    desired_totals=P("groups"),
+    penalty_nodes=P("groups", "nodes"),
+    affinity_scores=P("groups", "nodes"),
+    has_affinities=P("groups"),
+    spread_value_ids=P("groups", "nodes"),
+    spread_desired=P("groups", None),
+    spread_counts=P("groups", None),
+    spread_weights=P("groups"),
+    has_spreads=P("groups"),
+    distinct_hosts=P("groups"),
+    slot_caps=P("groups", "nodes"),
+    algorithm_spread=P(),
+    counts=P("groups"),
+)
+
+
+def test_place_batch_kernel_sharded_matches_single_device():
+    batch = graft._example_batch(n_nodes=512, n_groups=8, max_steps=8)
+    batch["counts"] = np.full(8, 8, dtype=np.int32)
+    batch["desired_totals"] = np.full(8, 8.0, dtype=np.float32)
+
+    ref_c, ref_s, ref_u = place_batch_kernel(**batch, max_steps=8)
+
+    mesh = _mesh()
+    sharded = _shard(batch, mesh, SPECS)
+    with mesh:
+        c, s, u = place_batch_kernel(**sharded, max_steps=8)
+        jax.block_until_ready((c, s, u))
+
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(ref_c))
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(ref_s))
+    np.testing.assert_allclose(np.asarray(u), np.asarray(ref_u), rtol=1e-6)
+    assert (np.asarray(c) >= 0).all()
+
+
+def test_closed_form_kernel_sharded_matches_single_device():
+    batch = graft._closed_form_batch(n_nodes=512, n_groups=8, count=16)
+
+    ref_c, ref_s = place_closed_form_kernel(**batch, max_j=16, k=16)
+
+    mesh = _mesh()
+    specs = {k: SPECS[k] for k in batch}
+    sharded = _shard(batch, mesh, specs)
+    with mesh:
+        c, s = place_closed_form_kernel(**sharded, max_j=16, k=16)
+        jax.block_until_ready((c, s))
+
+    np.testing.assert_array_equal(np.asarray(c), np.asarray(ref_c))
+    np.testing.assert_allclose(np.asarray(s), np.asarray(ref_s), rtol=1e-6)
+
+
+def test_score_matrix_kernel_node_sharded():
+    batch = graft._example_batch(n_nodes=512, n_groups=8, max_steps=8)
+    args = dict(
+        capacity=batch["capacity"],
+        used=batch["used0"],
+        asks=batch["asks"],
+        eligible=batch["eligible"],
+        job_counts=batch["job_counts"],
+        desired_totals=batch["desired_totals"],
+        penalty_nodes=batch["penalty_nodes"],
+        affinity_scores=batch["affinity_scores"],
+        has_affinities=batch["has_affinities"],
+        distinct_hosts=batch["distinct_hosts"],
+        algorithm_spread=batch["algorithm_spread"],
+    )
+    ref_final, ref_fits = score_matrix_kernel(**args)
+
+    mesh = _mesh()
+    specs = dict(SPECS, used=P("nodes", None))
+    sharded = _shard(args, mesh, specs)
+    with mesh:
+        final, fits = score_matrix_kernel(**sharded)
+        jax.block_until_ready((final, fits))
+
+    np.testing.assert_allclose(np.asarray(final), np.asarray(ref_final), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(fits), np.asarray(ref_fits))
+
+
+def test_mesh_shapes_1x8_and_4x2():
+    """The layout must work at other mesh aspect ratios (different dp/mp
+    splits of the same 8 chips)."""
+    batch = graft._closed_form_batch(n_nodes=512, n_groups=8, count=8)
+    ref_c, ref_s = place_closed_form_kernel(**batch, max_j=8, k=8)
+    for dp, mp in [(1, 8), (4, 2)]:
+        mesh = _mesh(dp, mp)
+        specs = {k: SPECS[k] for k in batch}
+        sharded = _shard(batch, mesh, specs)
+        with mesh:
+            c, s = place_closed_form_kernel(**sharded, max_j=8, k=8)
+            jax.block_until_ready((c, s))
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(ref_c))
+
+
+def test_dryrun_multichip_in_process(monkeypatch):
+    """With 8 virtual devices provisioned (conftest), the driver's dryrun
+    entry must run fully in-process and pass. NOMAD_TPU_DRYRUN_CHILD
+    forbids delegation, so a regression that breaks the in-process path
+    cannot hide behind a successful CPU child subprocess."""
+    monkeypatch.setenv("NOMAD_TPU_DRYRUN_CHILD", "1")
+    graft.dryrun_multichip(8)
