@@ -308,6 +308,10 @@ class _PyWal:
         body = _REC.pack(0, len(data), index, term, type_)[4:] + data
         crc = zlib.crc32(body) & 0xFFFFFFFF
         self._tail.write(struct.pack("<I", crc) + body)
+        # Flush through to the OS so a crash-stop (SIGKILL) loses nothing —
+        # the native store writes via unbuffered fds and has the same
+        # property; fsync (power-loss durability) remains sync()'s job.
+        self._tail.flush()
         self._tail_size += _REC.size + len(data)
         if self._first == 0:
             self._first = index

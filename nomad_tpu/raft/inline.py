@@ -51,6 +51,11 @@ class InlineRaft:
                     index, term=1, type_=int(mtype),
                     data=pickle.dumps(payload, pickle.HIGHEST_PROTOCOL),
                 )
+                # Durable-dev-agent contract: the write is acked to the
+                # caller, so it must survive power loss, not just
+                # crash-stop. fsync per apply (group-committed under the
+                # serializing lock).
+                self._wal.sync()
             result = self.fsm.apply(index, mtype, payload)
             if self._wal is not None:
                 self._applied_since_snap += 1

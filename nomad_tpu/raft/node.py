@@ -147,6 +147,9 @@ class RaftNode:
         self._threads: list[threading.Thread] = []
         self._futures: dict[int, Future] = {}
         self._apply_cv = threading.Condition(self._mu)
+        # serializes FSM mutation between the applier loop and
+        # InstallSnapshot restore; always acquired BEFORE _mu
+        self._apply_serial = threading.Lock()
         self._repl_events: dict[str, threading.Event] = {}
         self._clients: dict[str, RPCClient] = {}
         self._match_index: dict[str, int] = {}
@@ -279,6 +282,11 @@ class RaftNode:
             index = self._last_log()[0] + 1
             data = pickle.dumps(payload, pickle.HIGHEST_PROTOCOL)
             self.log.append(index, self.term, int(mtype), data)
+            # Raft stable-storage rule: the leader's own vote toward the
+            # commit majority only counts once the entry is durable — an
+            # acked commit must survive leader power loss (followers fsync
+            # in _handle_append_entries; the leader must too).
+            self.log.sync()
             fut: Future = Future()
             self._futures[index] = fut
             self._maybe_advance_commit_locked()
@@ -395,6 +403,7 @@ class RaftNode:
 
         index = last + 1
         self.log.append(index, self.term, int(MsgType.NOOP), pickle.dumps(None))
+        self.log.sync()  # durable before counting toward the majority
         self._maybe_advance_commit_locked()
         for p in self._next_index:
             ev = threading.Event()
@@ -635,7 +644,10 @@ class RaftNode:
             return {"term": self.term, "success": True, "match_index": last_new}
 
     def _handle_install_snapshot(self, args: dict) -> dict:
-        with self._mu:
+        # _apply_serial makes the restore atomic w.r.t. the applier's
+        # check-then-apply of individual log entries (lock order:
+        # _apply_serial before _mu)
+        with self._apply_serial, self._mu:
             if self._stop.is_set() or args["term"] < self.term:
                 return {"term": self.term}
             if args["term"] > self.term or self.state != FOLLOWER:
@@ -651,8 +663,11 @@ class RaftNode:
 
                 fd, path = tempfile.mkstemp(suffix=".snap")
                 os.close(fd)
-            with open(path, "wb") as f:
-                f.write(args["data"])
+            # atomic: our log prefix may already be compacted behind the
+            # previous snapshot, so tearing it on crash loses state
+            from ..state.snapshot import atomic_write_bytes
+
+            atomic_write_bytes(path, args["data"])
             self.restore_fn(path)
             self.snap_index = idx
             self.snap_term = args["last_included_term"]
@@ -684,16 +699,29 @@ class RaftNode:
                         break
                     entries.append((i, mtype, data))
             for i, mtype, data in entries:
-                payload = pickle.loads(data)
-                try:
-                    result = self.fsm.apply(i, mtype, payload)
-                    err = None
-                except Exception as e:  # noqa: BLE001 — surface to waiter
-                    result, err = None, e
-                with self._mu:
-                    self.last_applied = i
-                    fut = self._futures.pop(i, None)
-                    self._entries_since_snap += 1
+                # _apply_serial holds InstallSnapshot off for the duration
+                # of one entry's check+apply+update: without it, a restore
+                # could land between the staleness check and fsm.apply,
+                # and the stale entry would be applied onto the restored
+                # (newer) store.
+                with self._apply_serial:
+                    with self._mu:
+                        # entries at or below last_applied/snap_index are
+                        # already reflected in the restored store (and
+                        # their log may be gone) — applying them again
+                        # would regress the FSM
+                        if i <= self.last_applied or i <= self.snap_index:
+                            continue
+                    payload = pickle.loads(data)
+                    try:
+                        result = self.fsm.apply(i, mtype, payload)
+                        err = None
+                    except Exception as e:  # noqa: BLE001 — surface to waiter
+                        result, err = None, e
+                    with self._mu:
+                        self.last_applied = max(self.last_applied, i)
+                        fut = self._futures.pop(i, None)
+                        self._entries_since_snap += 1
                 if fut is not None and not fut.done():
                     if err is not None:
                         fut.set_exception(err)

@@ -9,12 +9,13 @@ calls with retry/rebalance on connection failure).
 from __future__ import annotations
 
 import itertools
+import logging
 import queue
 import socket
 import threading
 from typing import Any, Iterator, Optional
 
-from .framing import recv_frame, send_frame
+from .framing import FramingError, recv_frame, send_frame
 
 
 class RPCError(Exception):
@@ -45,11 +46,15 @@ class _Conn:
     def _read_loop(self) -> None:
         try:
             while True:
-                msg = recv_frame(self.sock)
+                msg = recv_frame(self.sock, expect_server=True)
                 with self.pending_lock:
                     q = self.pending.get(msg.get("seq"))
                 if q is not None:
                     q.put(msg)
+        except FramingError as e:
+            # protocol violation (bad auth, disallowed global, torn frame):
+            # drop the connection — callers see "connection closed"
+            logging.getLogger(__name__).warning("rpc: protocol violation: %s", e)
         except (ConnectionError, OSError):
             pass
         finally:

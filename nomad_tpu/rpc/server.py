@@ -14,7 +14,7 @@ import socket
 import threading
 from typing import Any, Callable, Dict, Iterator, Optional
 
-from .framing import recv_frame, send_frame
+from .framing import FramingError, recv_frame, send_frame
 
 log = logging.getLogger(__name__)
 
@@ -105,7 +105,10 @@ class RPCServer:
         try:
             while not self._stop.is_set():
                 try:
-                    msg = recv_frame(conn)
+                    msg = recv_frame(conn, expect_server=False)
+                except FramingError as e:
+                    log.warning("rpc: protocol violation from peer: %s", e)
+                    return
                 except (ConnectionError, OSError):
                     return
                 threading.Thread(
@@ -129,7 +132,7 @@ class RPCServer:
         def reply(payload: dict) -> None:
             payload["seq"] = seq
             with send_lock:
-                send_frame(conn, payload)
+                send_frame(conn, payload, server_side=True)
 
         if handler is None:
             try:

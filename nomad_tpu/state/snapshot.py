@@ -10,11 +10,28 @@ magic + version header so future migrations can dispatch.
 
 from __future__ import annotations
 
-import io
+import os
 import pickle
 
 SNAPSHOT_MAGIC = b"NOMADTPU-SNAP"
 SNAPSHOT_VERSION = 1
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` atomically (tmp + fsync + rename + dir
+    fsync): a crash mid-write leaves either the old file or the new one,
+    never a torn mix."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
 
 
 def save_snapshot(store, path: str) -> int:
@@ -37,9 +54,23 @@ def save_snapshot(store, path: str) -> int:
         "scaling_events": dict(snap._t.scaling_events),
         "scheduler_config": snap._t.scheduler_config,
     }
-    with open(path, "wb") as f:
+    # Atomic replace: never truncate the previous good snapshot. A crash
+    # mid-write must leave either the old snapshot or the new one — the WAL
+    # prefix behind the old snapshot is compacted, so a torn write here
+    # would permanently lose committed state (helper/snapshot does the
+    # same tmp+rename dance in the reference).
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(SNAPSHOT_MAGIC)
         pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dirfd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
     return snap.index
 
 
