@@ -116,6 +116,12 @@ class EvalBroker:
             self._pending_by_job.setdefault(job_key, _PQ()).push(ev)
             return
         self._ready.setdefault(ev.type, _PQ()).push(ev)
+        from ..utils.metrics import global_metrics
+
+        global_metrics.set_gauge(
+            "nomad.broker.total_ready",
+            sum(len(q) for t, q in self._ready.items() if t != FAILED_QUEUE),
+        )
 
     def _drain_delayed_locked(self) -> float:
         """Move due delayed evals to ready; return seconds to next firing."""
@@ -138,8 +144,9 @@ class EvalBroker:
         self, schedulers: list[str], timeout: float = 0.0
     ) -> tuple[Optional[Evaluation], str]:
         """Blocking dequeue for the given scheduler types. Returns
-        (eval, token) or (None, "") on timeout/disable."""
-        deadline = time.time() + timeout if timeout else None
+        (eval, token) or (None, "") on timeout/disable. ``timeout=0`` is
+        a non-blocking poll."""
+        deadline = time.time() + timeout
         with self._lock:
             while True:
                 if not self.enabled:
@@ -176,13 +183,29 @@ class EvalBroker:
                         self._delivery_count.get(ev.id, 0) + 1
                     )
                     return ev, token
-                if deadline is None:
-                    self._lock.wait(min(next_delay, 1.0))
-                else:
-                    remaining = deadline - time.time()
-                    if remaining <= 0:
-                        return None, ""
-                    self._lock.wait(min(remaining, next_delay, 1.0))
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None, ""
+                self._lock.wait(min(remaining, next_delay, 1.0))
+
+    def dequeue_many(
+        self, schedulers: list[str], max_n: int, timeout: float = 0.0
+    ) -> list[tuple[Evaluation, str]]:
+        """Dequeue up to ``max_n`` ready evals in one call — the intake of
+        the batched multi-eval device pass (SURVEY.md §7 step 5). The
+        first eval blocks up to ``timeout``; the rest are taken only if
+        immediately ready. Per-job serialization holds: two evals of one
+        job can never be in the same batch (or in flight at all)."""
+        first = self.dequeue(schedulers, timeout=timeout)
+        if first[0] is None:
+            return []
+        out = [first]
+        while len(out) < max_n:
+            nxt = self.dequeue(schedulers, timeout=0.0)
+            if nxt[0] is None:
+                break
+            out.append(nxt)
+        return out
 
     # -- ack / nack --------------------------------------------------------
     def _validate(self, eval_id: str, token: str) -> Evaluation:

@@ -26,6 +26,7 @@ from ..structs import (
     PlanResult,
     allocs_fit,
 )
+from ..utils.metrics import global_metrics as metrics
 
 
 def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
@@ -214,8 +215,9 @@ class PlanApplier:
         self._lock = threading.Lock()
 
     def apply(self, plan: Plan) -> PlanResult:
-        with self._lock:
-            result = evaluate_plan(self.store, plan)
+        with self._lock, metrics.timer("nomad.plan.apply"):
+            with metrics.timer("nomad.plan.evaluate"):
+                result = evaluate_plan(self.store, plan)
             if not result.is_no_op() or result.deployment is not None:
                 evals = (
                     preemption_evals(self.store, result)

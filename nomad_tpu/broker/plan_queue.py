@@ -19,6 +19,7 @@ from concurrent.futures import Future
 from typing import Optional
 
 from ..structs import Plan, PlanResult
+from ..utils.metrics import global_metrics as metrics
 from .plan_apply import PlanApplier
 
 
@@ -54,6 +55,7 @@ class PlanQueue:
                 return f
             pending = PendingPlan(plan)
             heapq.heappush(self._heap, (-plan.priority, next(self._c), pending))
+            metrics.set_gauge("nomad.plan.queue_depth", len(self._heap))
             self._lock.notify_all()
             return pending.future
 

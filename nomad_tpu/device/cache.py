@@ -1,0 +1,186 @@
+"""DeviceStateCache — resident cluster tensors refreshed incrementally.
+
+SURVEY.md §7 "latency floor": the device arrays are a *derived cache* of
+the state store's node/alloc tables, refreshed by state-index watermark
+(the ``SnapshotMinIndex`` analog, nomad/worker.go:536-549) — NOT rebuilt
+per evaluation. The store's ChangeJournal (state/store.py) records which
+node rows were touched; the cache patches exactly those rows.
+
+Generational copy-on-write: a refresh builds new arrays (cheap — O(N·D)
+numpy copies) and swaps the generation, so evals holding the previous
+``ClusterTensors`` keep reading frozen state — the same MVCC discipline
+the store itself uses.
+
+Full rebuilds happen only when the journal can't cover the interval, a
+node disappears or changes class/datacenter (representative-node
+semantics would go stale), or the padded node bucket overflows.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import replace
+
+import numpy as np
+
+from ..structs.resources import node_comparable_capacity
+from .flatten import ClusterTensors, flatten_cluster
+
+
+def _node_used(snap, node_id: str, dims: int) -> np.ndarray:
+    vec = np.zeros(dims, dtype=np.float32)
+    for a in snap.allocs_by_node(node_id):
+        if not a.terminal_status():
+            vec += a.comparable_resources().to_vector()
+    return vec
+
+
+class DeviceStateCache:
+    """One per server/harness; thread-safe. ``tensors(snap)`` returns a
+    ClusterTensors at exactly ``snap.index`` whose ``used`` array is a
+    private copy (schedulers overlay in-plan stops/preemptions onto it)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ct: ClusterTensors | None = None
+        # instrumentation: test_device_cache asserts full_flattens stays 1
+        # across eval storms; metrics surface these (nomad.worker.* analog)
+        self.full_flattens = 0
+        self.incremental_refreshes = 0
+        self.hits = 0
+        self.stale_builds = 0  # older-than-resident snapshots (transient)
+
+    # -- public -----------------------------------------------------------
+    def tensors(self, snap) -> ClusterTensors:
+        with self._lock:
+            ct = self._refresh_locked(snap)
+            return replace(ct, used=ct.used.copy())
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._ct = None
+
+    # -- refresh machinery -------------------------------------------------
+    def _rebuild_locked(self, snap) -> ClusterTensors:
+        self.full_flattens += 1
+        self._ct = flatten_cluster(snap)
+        return self._ct
+
+    def _refresh_locked(self, snap) -> ClusterTensors:
+        ct = self._ct
+        if ct is not None and snap.index < ct.index:
+            # a worker holding an older snapshot than the resident
+            # generation: serve it a transient build WITHOUT regressing
+            # the shared generation (other workers would have to patch
+            # forward again — flatten ping-pong)
+            self.stale_builds += 1
+            return flatten_cluster(snap)
+        if ct is None:
+            return self._rebuild_locked(snap)
+        if snap.index == ct.index:
+            self.hits += 1
+            return ct
+        journal = getattr(snap, "journal", None)
+        if journal is None:
+            return self._rebuild_locked(snap)
+        changes = journal.since(ct.index, snap.index)
+        if changes is None:
+            return self._rebuild_locked(snap)
+        node_keys = changes.get("nodes", set())
+        alloc_nodes = changes.get("node_allocs", set())
+        if not node_keys and not alloc_nodes:
+            # index advanced without touching schedulable state
+            self._ct = replace(ct, index=snap.index)
+            self.hits += 1
+            return self._ct
+
+        new_nodes: list = []
+        for nid in node_keys:
+            node = snap.node_by_id(nid)
+            if node is None:
+                return self._rebuild_locked(snap)  # node removed
+            row = ct.node_row.get(nid)
+            if row is None:
+                new_nodes.append(node)
+                continue
+            # class/dc changes invalidate representative-node memoization
+            cid = ct.class_vocab.get(node.computed_class or "")
+            if cid is None or cid != ct.class_ids[row]:
+                return self._rebuild_locked(snap)
+            did = ct.dc_vocab.get(node.datacenter)
+            if did is None or did != ct.dc_ids[row]:
+                return self._rebuild_locked(snap)
+        if ct.num_nodes + len(new_nodes) > ct.padded_n:
+            return self._rebuild_locked(snap)  # bucket overflow
+
+        self.incremental_refreshes += 1
+        dims = ct.capacity.shape[1]
+        capacity = ct.capacity.copy()
+        used = ct.used.copy()
+        ready = ct.ready.copy()
+        dc_ids = ct.dc_ids.copy()
+        class_ids = ct.class_ids.copy()
+        node_ids = list(ct.node_ids)
+        nodes = list(ct.nodes)
+        node_row = dict(ct.node_row)
+        dc_vocab = dict(ct.dc_vocab)
+        class_vocab = dict(ct.class_vocab)
+        class_rep = list(ct.class_rep)
+        num_nodes = ct.num_nodes
+        # attribute columns referencing changed nodes go stale; drop them
+        # (recomputed lazily — node attribute changes are rare next to
+        # alloc churn, which never touches these)
+        attr_cache = dict(ct.attr_cache) if not node_keys else {}
+
+        for node in new_nodes:
+            row = num_nodes
+            num_nodes += 1
+            node_row[node.id] = row
+            node_ids.append(node.id)
+            nodes.append(node)
+            if not node.computed_class:
+                node.compute_class()
+            cid = class_vocab.setdefault(node.computed_class, len(class_vocab))
+            if cid == len(class_rep):
+                class_rep.append(row)
+            class_ids[row] = cid
+            dc_ids[row] = dc_vocab.setdefault(node.datacenter, len(dc_vocab))
+            capacity[row] = node_comparable_capacity(node).to_vector()
+            ready[row] = node.ready()
+            used[row] = _node_used(snap, node.id, dims)
+
+        for nid in node_keys:
+            row = node_row[nid]
+            if row >= ct.num_nodes:
+                continue  # appended above
+            node = snap.node_by_id(nid)
+            nodes[row] = node
+            capacity[row] = node_comparable_capacity(node).to_vector()
+            ready[row] = node.ready()
+            used[row] = _node_used(snap, nid, dims)
+
+        for nid in alloc_nodes:
+            if nid in node_keys:
+                continue  # already recomputed
+            row = node_row.get(nid)
+            if row is None:
+                continue  # alloc on an unknown node — nothing resident
+            used[row] = _node_used(snap, nid, dims)
+
+        self._ct = ClusterTensors(
+            node_ids=node_ids,
+            index=snap.index,
+            num_nodes=num_nodes,
+            capacity=capacity,
+            used=used,
+            ready=ready,
+            dc_ids=dc_ids,
+            class_ids=class_ids,
+            dc_vocab=dc_vocab,
+            class_vocab=class_vocab,
+            class_rep=class_rep,
+            node_row=node_row,
+            nodes=nodes,
+            attr_cache=attr_cache,
+        )
+        return self._ct

@@ -70,6 +70,13 @@ class ClusterTensors:
     # per-class representative node index (for host-side class evaluation)
     class_rep: list[int]
     node_row: dict[str, int] = field(default_factory=dict)
+    # row-ordered Node objects (nodes[i] ↔ row i); kept in sync by the
+    # flattener / DeviceStateCache so host-side per-class constraint
+    # evaluation never re-sorts the cluster
+    nodes: list = field(default_factory=list)
+    # attribute → (value_ids i32[N], vocab dict) — lazily built columns for
+    # spread/property attributes, owned by the cache generation
+    attr_cache: dict = field(default_factory=dict)
 
     @property
     def padded_n(self) -> int:
@@ -77,6 +84,21 @@ class ClusterTensors:
 
     def row_of(self, node_id: str) -> int:
         return self.node_row[node_id]
+
+    def attr_column(self, attr: str) -> tuple[np.ndarray, dict[str, int]]:
+        """Per-node value ids for one attribute (-1 = absent), cached.
+        The vocab grows append-only so cached GroupAsk ids stay valid."""
+        cached = self.attr_cache.get(attr)
+        if cached is not None:
+            return cached
+        ids = np.full(self.padded_n, -1, dtype=np.int32)
+        vocab: dict[str, int] = {}
+        for i in range(self.num_nodes):
+            v = self.nodes[i].lookup_attribute(attr)
+            if v is not None:
+                ids[i] = vocab.setdefault(str(v), len(vocab))
+        self.attr_cache[attr] = (ids, vocab)
+        return ids, vocab
 
 
 def flatten_cluster(snap, nodes=None) -> ClusterTensors:
@@ -133,6 +155,7 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
         class_vocab=class_vocab,
         class_rep=class_rep,
         node_row=node_row,
+        nodes=list(nodes),
     )
 
 
@@ -278,19 +301,35 @@ def _eligibility_for_group(
 
 def _affinity_scores(ct, nodes_sorted, job: Job, tg: TaskGroup) -> tuple[np.ndarray, bool]:
     """Weight-normalized affinity score per node, in [-1, 1]
-    (scheduler/rank.go:650-737: Σ w_i·match_i / Σ|w_i|)."""
+    (scheduler/rank.go:650-737: Σ w_i·match_i / Σ|w_i|).
+
+    Class-stable affinities (no ``unique.`` target) are evaluated once per
+    computed node class and broadcast — O(classes), not O(nodes), the same
+    memoization bet the feasibility path makes (feasible.go:1029)."""
     affs = job.affinities_for_group(tg)
     scores = np.zeros(ct.padded_n, dtype=np.float32)
     if not affs:
         return scores, False
+    from ..structs import Constraint
+
+    n = ct.num_nodes
     total = float(sum(abs(a.weight) for a in affs)) or 1.0
     for a in affs:
-        from ..structs import Constraint
-
         c = Constraint(l_target=a.l_target, r_target=a.r_target, operand=a.operand)
-        for i in range(ct.num_nodes):
-            if _check_constraint(nodes_sorted[i], c):
-                scores[i] += a.weight
+        if "unique." in c.l_target or "unique." in c.r_target:
+            match = np.fromiter(
+                (_check_constraint(nodes_sorted[i], c) for i in range(n)),
+                dtype=bool,
+                count=n,
+            )
+        else:
+            rep_ok = np.fromiter(
+                (_check_constraint(nodes_sorted[r], c) for r in ct.class_rep),
+                dtype=bool,
+                count=len(ct.class_rep),
+            )
+            match = rep_ok[ct.class_ids[:n]]
+        scores[:n] += np.where(match, np.float32(a.weight), np.float32(0.0))
     return scores / total, True
 
 
@@ -313,12 +352,7 @@ def _spread_tensors(ct, nodes_sorted, job: Job, tg: TaskGroup, snap, total_desir
     # spreads are scored against the first block. TODO(round2): stack
     # value-id planes per block and sum boosts in-kernel.
     sp = spreads[0]
-    value_ids: dict[str, int] = {}
-    node_vals = np.full(pn, -1, dtype=np.int32)
-    for i in range(ct.num_nodes):
-        v = nodes_sorted[i].lookup_attribute(sp.attribute)
-        if v is not None:
-            node_vals[i] = value_ids.setdefault(v, len(value_ids))
+    node_vals, value_ids = ct.attr_column(sp.attribute)
     nv = max(len(value_ids), 1)
     desired = np.zeros(nv, dtype=np.float32)
     if sp.targets:
@@ -409,7 +443,9 @@ def flatten_group_ask(
 ) -> GroupAsk:
     """Flatten one (job, task group, count) placement request."""
     if nodes_sorted is None:
-        nodes_sorted = (
+        # row-ordered node objects from the tensors themselves; falling
+        # back to a sort only for hand-built ClusterTensors without them
+        nodes_sorted = ct.nodes or (
             sorted(snap.nodes(), key=lambda n: n.id) if snap is not None else []
         )
     ask_res = tg.combined_resources()

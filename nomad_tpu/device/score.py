@@ -434,6 +434,47 @@ def place_closed_form_kernel(
     )
 
 
+def _dummy_ask(pn: int):
+    """Zero-count padding lane for the group axis: eligible nowhere, so
+    the kernel places nothing and its lane is dropped on unpack. Keeps
+    the compiled G dimension bucketed (recompiles are the real cost of a
+    varying batch size, not the padded FLOPs)."""
+    from .flatten import GroupAsk
+
+    return GroupAsk(
+        job_id="",
+        tg_name="",
+        count=0,
+        desired_total=1,
+        ask=np.zeros(4, dtype=np.float32),
+        eligible=np.zeros(pn, dtype=bool),
+        job_counts=np.zeros(pn, dtype=np.int32),
+        penalty_nodes=np.zeros(pn, dtype=bool),
+        affinity_scores=np.zeros(pn, dtype=np.float32),
+        has_affinities=False,
+        distinct_hosts=False,
+        spread_value_ids=np.full(pn, -1, dtype=np.int32),
+        spread_desired=np.zeros(1, dtype=np.float32),
+        spread_initial_counts=np.zeros(1, dtype=np.float32),
+        spread_weight=0.0,
+        has_spreads=False,
+        num_spread_values=1,
+    )
+
+
+def _pad_group_axis(asks: list, pn: int) -> list:
+    """Pad the ask list so the compiled G dimension takes only two small
+    values: 1 (single-eval path) or a power-of-two ≥ 16 (batched path).
+    Collapsing 2..16 asks onto one 16-lane executable costs padded vmap
+    lanes but avoids a recompile per distinct batch size."""
+    n = len(asks)
+    g = 1 if n == 1 else max(16, _steps_bucket(n))
+    if g == n:
+        return asks
+    dummy = _dummy_ask(pn)
+    return asks + [dummy] * (g - n)
+
+
 def _shared_batch(asks: list, pn: int) -> dict:
     """Host-side assembly of the kernel inputs common to both placement
     paths (the spread-only fields are added by the scan path)."""
@@ -528,6 +569,8 @@ class PlacementKernel:
                 )
             return out
 
+        real_n = len(asks)
+        asks = _pad_group_axis(asks, pn)
         batch = _shared_batch(asks, pn)
         choices, scores = place_closed_form_kernel(
             jnp.asarray(cluster.capacity),
@@ -543,14 +586,16 @@ class PlacementKernel:
             PlacementResult(
                 node_rows=choices[gi, : a.count], scores=scores[gi, : a.count]
             )
-            for gi, a in enumerate(asks)
+            for gi, a in enumerate(asks[:real_n])
         ]
 
     def _place_scan_batch(self, cluster, asks: list) -> list[PlacementResult]:
         pn = cluster.padded_n
+        real_n = len(asks)
+        asks = _pad_group_axis(asks, pn)
         max_count = max(a.count for a in asks)
         max_steps = _steps_bucket(max(max_count, 1))
-        max_v = max(a.num_spread_values for a in asks)
+        max_v = _steps_bucket(max(a.num_spread_values for a in asks))
 
         def pad_v(arr, fill=0.0):
             out = np.full(max_v, fill, dtype=np.float32)
@@ -579,7 +624,7 @@ class PlacementKernel:
         choices = np.asarray(choices)
         scores = np.asarray(scores)
         out = []
-        for gi, a in enumerate(asks):
+        for gi, a in enumerate(asks[:real_n]):
             # scan emits [steps, ...] per lane → transpose handled by vmap:
             # choices has shape [G, steps]
             ch = choices[gi, : a.count]

@@ -712,7 +712,12 @@ class RaftNode:
                         # would regress the FSM
                         if i <= self.last_applied or i <= self.snap_index:
                             continue
-                    payload = pickle.loads(data)
+                    # log entries can originate from the network
+                    # (append_entries from any peer) — deserialize through
+                    # the framework allowlist, not bare pickle
+                    from ..rpc.framing import restricted_loads
+
+                    payload = restricted_loads(data)
                     try:
                         result = self.fsm.apply(i, mtype, payload)
                         err = None

@@ -15,7 +15,8 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from ..device import PlacementKernel, flatten_cluster, flatten_group_ask
+from ..device import PlacementKernel, flatten_group_ask
+from ..device.cache import DeviceStateCache
 from ..structs import (
     ALLOC_DESIRED_RUN,
     EVAL_STATUS_COMPLETE,
@@ -73,10 +74,16 @@ def tainted_nodes(snapshot, allocs) -> dict:
 @register_scheduler("service")
 @register_scheduler("batch")
 class GenericScheduler:
-    def __init__(self, snapshot, planner: Planner, *, batch: bool = False):
+    def __init__(
+        self, snapshot, planner: Planner, *, batch: bool = False, cache=None
+    ):
         self.snapshot = snapshot
         self.planner = planner
         self.batch = batch
+        # resident device-state cache — per-server in production (the
+        # worker threads share it); a private one here keeps standalone
+        # scheduler construction working
+        self.cache = cache if cache is not None else DeviceStateCache()
         self.kernel: Optional[PlacementKernel] = None
         self.eval: Optional[Evaluation] = None
         self.job = None
@@ -124,6 +131,59 @@ class GenericScheduler:
     # -- one attempt ------------------------------------------------------
     def _process_once(self) -> tuple[bool, bool]:
         """Returns (done, should_retry)."""
+        placements = self._start_attempt()
+        if placements and self.job is not None:
+            ct, tg_order = self._build_group_asks(placements)
+            results = self.kernel.place(ct, [t[3] for t in tg_order])
+            self._finish_placements(ct, tg_order, results)
+            self._adjust_queued()
+        return self._submit_attempt()
+
+    # -- batched multi-eval pass (SURVEY.md §7 step 5) --------------------
+    def prepare_batch_attempt(self, evaluation: Evaluation):
+        """Phase A of a batched multi-eval device pass: run the host side
+        (reconcile + flatten) and return this eval's group asks for the
+        caller to merge into one kernel call across evals — the batch
+        dimension replacing the reference's worker-per-core concurrency
+        (nomad/worker.go:85, SURVEY.md §2.7).
+
+        Returns the list of GroupAsks, or None when the eval needs the
+        individual path: no placement work at all, or a plan whose
+        evictions couple placements to freed capacity (the in-plan used
+        overlay is eval-local and can't share one batched ``used0``).
+        """
+        self.eval = evaluation
+        self.batch = self.batch or evaluation.type == "batch"
+        cfg = self.snapshot.scheduler_config()
+        self.scheduler_config = cfg
+        self.kernel = PlacementKernel(cfg.scheduler_algorithm)
+        placements = self._start_attempt()
+        if not placements or self.job is None:
+            return None
+        if self.plan.node_update or self.plan.node_preemptions:
+            return None  # evictions free capacity only for this eval's plan
+        ct, tg_order = self._build_group_asks(placements)
+        self._batch_ctx = (ct, tg_order)
+        return [t[3] for t in tg_order]
+
+    def complete_batch_attempt(self, results) -> bool:
+        """Phase B: consume this eval's slice of the combined kernel
+        results. Returns True when the eval is fully handled (plan
+        committed, eval finalized); False when the caller must fall back
+        to the individual retry path on a fresh scheduler (partial
+        commit against the optimistic shared snapshot)."""
+        ct, tg_order = self._batch_ctx
+        self._finish_placements(ct, tg_order, results)
+        self._adjust_queued()
+        done, _retry = self._submit_attempt()
+        if not done:
+            return False
+        self._finalize()
+        return True
+
+    def _start_attempt(self):
+        """Host-side first half of one attempt: reconcile and build the
+        plan's stops/updates; returns the placements list."""
         ev = self.eval
         self.failed_tg_allocs = {}
         self.followup_evals = []
@@ -219,23 +279,26 @@ class GenericScheduler:
             tg: c["place"] + c["destructive_update"]
             for tg, c in results.desired_tg_updates.items()
         }
+        return placements
 
-        if placements and self.job is not None:
-            self._compute_placements(placements, tainted)
-            # queued = what we could NOT place (adjustQueuedAllocations,
-            # scheduler/util.go:954 — planned allocs are subtracted)
-            placed_per_tg: dict[str, int] = {}
-            for allocs in self.plan.node_allocation.values():
-                for a in allocs:
-                    if a.eval_id == self.eval.id and a.client_status == "pending":
-                        placed_per_tg[a.task_group] = (
-                            placed_per_tg.get(a.task_group, 0) + 1
-                        )
-            for tg in list(self.queued_allocs):
-                self.queued_allocs[tg] = max(
-                    0, self.queued_allocs[tg] - placed_per_tg.get(tg, 0)
-                )
+    def _adjust_queued(self) -> None:
+        """queued = what we could NOT place (adjustQueuedAllocations,
+        scheduler/util.go:954 — planned allocs are subtracted)."""
+        placed_per_tg: dict[str, int] = {}
+        for allocs in self.plan.node_allocation.values():
+            for a in allocs:
+                if a.eval_id == self.eval.id and a.client_status == "pending":
+                    placed_per_tg[a.task_group] = (
+                        placed_per_tg.get(a.task_group, 0) + 1
+                    )
+        for tg in list(self.queued_allocs):
+            self.queued_allocs[tg] = max(
+                0, self.queued_allocs[tg] - placed_per_tg.get(tg, 0)
+            )
 
+    def _submit_attempt(self) -> tuple[bool, bool]:
+        """Second half of one attempt: no-op check → submit → full-commit
+        check. Returns (done, should_retry)."""
         if self.plan.is_no_op() and not self.followup_evals:
             self._finished = True
             return True, False
@@ -255,14 +318,13 @@ class GenericScheduler:
         return True, False
 
     # -- placement via the device kernel ---------------------------------
-    def _compute_placements(self, placements, tainted) -> None:
-        """Batch all of this eval's placements into one device pass
-        (replaces computePlacements' per-alloc stack.Select walk)."""
+    def _build_group_asks(self, placements):
+        """Flatten this eval's placements into dense group asks against
+        the resident tensors (replaces computePlacements' per-alloc
+        stack.Select walk). Returns (ct, tg_order)."""
         snap = self.snapshot
-        nodes_sorted = sorted(
-            (n for n in snap.nodes()), key=lambda n: n.id
-        )
-        ct = flatten_cluster(snap, nodes_sorted)
+        ct = self.cache.tensors(snap)
+        nodes_sorted = ct.nodes
         # overlay this plan's own stops (evicted allocs free capacity)
         for node_id, stops in self.plan.node_update.items():
             row = ct.node_row.get(node_id)
@@ -276,7 +338,6 @@ class GenericScheduler:
         for pr in placements:
             by_tg.setdefault(pr.task_group.name, []).append(pr)
 
-        asks = []
         tg_order = []
         for tg_name, prs in by_tg.items():
             tg = self.job.lookup_task_group(tg_name)
@@ -294,11 +355,13 @@ class GenericScheduler:
                 nodes_sorted=nodes_sorted,
                 penalty_node_ids=penalty_nodes,
             )
-            asks.append(ga)
             tg_order.append((tg_name, prs, tg, ga))
+        return ct, tg_order
 
-        results = self.kernel.place(ct, asks)
-
+    def _finish_placements(self, ct, tg_order, results) -> None:
+        """Consume kernel results: build allocations, run the preemption
+        fallback for failures, record metrics."""
+        nodes_sorted = ct.nodes
         nodes_available = {}
         for n in nodes_sorted:
             if n.ready():

@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..device import flatten_cluster, flatten_group_ask
+from ..device import flatten_group_ask
+from ..device.cache import DeviceStateCache
 from ..device.score import score_matrix_kernel
 from ..structs import (
     ALLOC_DESIRED_RUN,
@@ -33,10 +34,13 @@ MAX_SYSTEM_SCHEDULE_ATTEMPTS = 5  # scheduler_system.go:12-21
 @register_scheduler("system")
 @register_scheduler("sysbatch")
 class SystemScheduler:
-    def __init__(self, snapshot, planner: Planner, *, sysbatch: bool = False):
+    def __init__(
+        self, snapshot, planner: Planner, *, sysbatch: bool = False, cache=None
+    ):
         self.snapshot = snapshot
         self.planner = planner
         self.sysbatch = sysbatch
+        self.cache = cache if cache is not None else DeviceStateCache()
         self.eval = None
         self.job = None
         self.plan = None
@@ -92,8 +96,8 @@ class SystemScheduler:
                 self.plan.append_stopped_alloc(a, REASON_ALLOC_NOT_NEEDED)
             return self._submit()
 
-        nodes_sorted = sorted(self.snapshot.nodes(), key=lambda n: n.id)
-        ct = flatten_cluster(self.snapshot, nodes_sorted)
+        ct = self.cache.tensors(self.snapshot)
+        nodes_sorted = ct.nodes
 
         for tg in self.job.task_groups:
             ga = flatten_group_ask(

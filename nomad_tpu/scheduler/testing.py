@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 from ..broker.plan_apply import evaluate_plan
+from ..device.cache import DeviceStateCache
 from ..state import StateStore
 from ..structs import Evaluation, Plan, PlanResult
 from .scheduler import new_scheduler
@@ -20,6 +21,7 @@ from .scheduler import new_scheduler
 class Harness:
     def __init__(self, store: Optional[StateStore] = None):
         self.store = store or StateStore()
+        self.device_cache = DeviceStateCache()
         self.plans: list[Plan] = []
         self.evals: list[Evaluation] = []
         self.created_evals: list[Evaluation] = []
@@ -73,6 +75,7 @@ class Harness:
         """Run the right scheduler for the eval type against a fresh
         snapshot (testing.go:270 Process)."""
         sched = new_scheduler(
-            evaluation.type, self.store.snapshot(), self
+            evaluation.type, self.store.snapshot(), self,
+            cache=self.device_cache,
         )
         sched.process(evaluation)

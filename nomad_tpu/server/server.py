@@ -75,6 +75,11 @@ class Server:
             commit=self._commit_plan_result,
         )
         self.workers: list[Worker] = []
+        # resident device tensors shared by all workers, refreshed
+        # incrementally by state index (SURVEY.md §7 'latency floor')
+        from ..device.cache import DeviceStateCache
+
+        self.device_cache = DeviceStateCache()
         self._raft_lock = threading.Lock()
         self._leader = False
         from ..broker.event_broker import EventBroker as StreamBroker
@@ -123,6 +128,9 @@ class Server:
         self.store = store
         self.plan_apply_loop.applier.store = store
         store.add_listener(self._on_state_change)
+        # the restored store has a fresh journal that never names entities
+        # deleted across the swap — resident tensors must rebuild
+        self.device_cache.invalidate()
         return store.latest_index
 
     def attach_raft(self, raft) -> None:

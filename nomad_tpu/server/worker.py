@@ -21,10 +21,18 @@ from typing import Optional
 
 from ..scheduler import new_scheduler
 from ..structs import Evaluation, Plan
+from ..utils.metrics import global_metrics as metrics
 
 log = logging.getLogger("nomad_tpu.worker")
 
 SCHEDULER_TYPES = ["service", "batch", "system", "sysbatch", "_core"]
+
+# evals packed into one batched device pass (SURVEY.md §7 step 5): the
+# batch dimension of the placement kernel replaces the reference's
+# worker-per-core concurrency (nomad/config.go:468). Each eval still
+# submits its own plan; the serialized applier resolves conflicts exactly
+# as it does for the reference's parallel workers.
+EVAL_BATCH_SIZE = 16
 
 
 class Worker:
@@ -64,38 +72,117 @@ class Worker:
             if self._paused.is_set():
                 self._stop.wait(0.1)
                 continue
-            ev, token = self.server.eval_broker.dequeue(
-                self.schedulers, timeout=0.2
+            with metrics.timer("nomad.worker.dequeue_eval"):
+                batch = self.server.eval_broker.dequeue_many(
+                    self.schedulers, EVAL_BATCH_SIZE, timeout=0.2
+                )
+            if not batch:
+                continue
+            if len(batch) == 1:
+                self._run_one(*batch[0])
+            else:
+                self._run_batch(batch)
+
+    def _run_one(self, ev: Evaluation, token: str) -> None:
+        self._eval_token = token
+        try:
+            self.process_eval(ev)
+            self.server.eval_broker.ack(ev.id, token)
+            self.stats["acked"] += 1
+        except Exception:
+            log.exception("worker %d: eval %s failed", self.id, ev.id)
+            try:
+                self.server.eval_broker.nack(ev.id, token)
+            except ValueError:
+                pass
+            self.stats["nacked"] += 1
+        self.stats["processed"] += 1
+
+    def _run_batch(self, batch: list[tuple[Evaluation, str]]) -> None:
+        """Process a batch of evals through one combined device pass.
+        Evals the batch path can't take (system jobs, eviction-coupled
+        plans, failed batch attempts) fall back to the individual path."""
+        with metrics.timer("nomad.worker.wait_for_index"):
+            self.server.store.wait_for_index(
+                max(ev.modify_index for ev, _ in batch), timeout=5.0
             )
-            if ev is None:
+        snapshot = self.server.store.snapshot()
+
+        prepared = []  # (ev, token, sched, n_asks)
+        all_asks: list = []
+        singles: list[tuple[Evaluation, str]] = []
+        for ev, token in batch:
+            if ev.type not in ("service", "batch"):
+                singles.append((ev, token))
                 continue
             self._eval_token = token
+            sched = new_scheduler(
+                ev.type, snapshot, self, cache=self.server.device_cache
+            )
             try:
-                self.process_eval(ev)
-                self.server.eval_broker.ack(ev.id, token)
-                self.stats["acked"] += 1
+                asks = sched.prepare_batch_attempt(ev)
             except Exception:
-                log.exception("worker %d: eval %s failed", self.id, ev.id)
+                log.exception("worker %d: batch prepare %s", self.id, ev.id)
+                asks = None
+                singles.append((ev, token))
+                continue
+            if asks is None:
+                singles.append((ev, token))
+            else:
+                prepared.append((ev, token, sched, len(asks)))
+                all_asks.extend(asks)
+
+        results = []
+        if all_asks:
+            ct = prepared[0][2]._batch_ctx[0]
+            with metrics.timer("nomad.worker.invoke_scheduler"):
+                results = prepared[0][2].kernel.place(ct, all_asks)
+
+        off = 0
+        for ev, token, sched, n in prepared:
+            span = results[off : off + n]
+            off += n
+            self._eval_token = token
+            try:
+                if sched.complete_batch_attempt(span):
+                    self.server.eval_broker.ack(ev.id, token)
+                    self.stats["acked"] += 1
+                    self.stats["processed"] += 1
+                else:
+                    # optimistic conflict: re-run individually on fresh state
+                    singles.append((ev, token))
+            except Exception:
+                log.exception("worker %d: batch complete %s", self.id, ev.id)
                 try:
                     self.server.eval_broker.nack(ev.id, token)
                 except ValueError:
                     pass
                 self.stats["nacked"] += 1
-            self.stats["processed"] += 1
+                self.stats["processed"] += 1
+
+        for ev, token in singles:
+            self._run_one(ev, token)
 
     def process_eval(self, ev: Evaluation) -> None:
         # raft catch-up barrier (worker.go:536-549)
-        self.server.store.wait_for_index(ev.modify_index, timeout=5.0)
+        with metrics.timer("nomad.worker.wait_for_index"):
+            self.server.store.wait_for_index(ev.modify_index, timeout=5.0)
         snapshot = self.server.store.snapshot()
-        sched = new_scheduler(ev.type, snapshot, self)
-        sched.process(ev)
+        # all workers share the server's resident device-state cache —
+        # tensors refresh incrementally by state index, not per eval
+        sched = new_scheduler(
+            ev.type, snapshot, self, cache=self.server.device_cache
+        )
+        with metrics.timer("nomad.worker.invoke_scheduler"):
+            sched.process(ev)
 
     # -- Planner interface (worker.go:585-767) -----------------------------
     def submit_plan(self, plan: Plan):
         plan.eval_token = self._eval_token
         plan.normalize()
-        future = self.server.plan_queue.enqueue(plan)
-        result = future.result(timeout=30)
+        with metrics.timer("nomad.worker.submit_plan"):
+            future = self.server.plan_queue.enqueue(plan)
+            result = future.result(timeout=30)
         new_snapshot = None
         if result.refresh_index:
             self.server.store.wait_for_index(result.refresh_index, timeout=5.0)

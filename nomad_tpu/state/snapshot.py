@@ -82,7 +82,11 @@ def restore_snapshot(path: str):
         magic = f.read(len(SNAPSHOT_MAGIC))
         if magic != SNAPSHOT_MAGIC:
             raise ValueError(f"{path} is not a nomad-tpu snapshot")
-        payload = pickle.load(f)
+        # snapshot blobs arrive over the wire too (Raft InstallSnapshot) —
+        # deserialize through the framework allowlist, not bare pickle
+        from ..rpc.framing import restricted_loads
+
+        payload = restricted_loads(f.read())
     if payload["version"] != SNAPSHOT_VERSION:
         raise ValueError(f"unsupported snapshot version {payload['version']}")
 

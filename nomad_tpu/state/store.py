@@ -126,13 +126,55 @@ class _Tables:
     )
 
 
+class ChangeJournal:
+    """Bounded append-only log of (index, table, key) write records — the
+    watch-set analog (nomad/state/state_store.go WatchSet) that lets the
+    device-state cache refresh resident tensors incrementally instead of
+    re-flattening the cluster per eval (SURVEY.md §7 'latency floor').
+
+    Only the tables the flattening layer consumes are journaled (nodes,
+    allocs). Readers ask for changes in an index interval; ``None`` means
+    the journal was trimmed past the interval and the reader must rebuild.
+    """
+
+    def __init__(self, cap: int = 500_000):
+        self._entries: list[tuple[int, str, object]] = []
+        self._cap = cap
+        self._floor = 0  # records with index <= floor may have been trimmed
+        self._lock = threading.Lock()
+
+    def note(self, index: int, table: str, key) -> None:
+        with self._lock:
+            self._entries.append((index, table, key))
+            if len(self._entries) > self._cap:
+                drop = len(self._entries) // 2
+                self._floor = self._entries[drop - 1][0]
+                del self._entries[:drop]
+
+    def since(self, after_index: int, upto_index: int):
+        """Changes with after_index < index <= upto_index, as
+        {table: set(keys)}, or None if the interval fell off the journal."""
+        with self._lock:
+            if after_index < self._floor:
+                return None
+            out: dict[str, set] = {}
+            # entries are appended in index order; scan from the back
+            for idx, table, key in reversed(self._entries):
+                if idx <= after_index:
+                    break
+                if idx <= upto_index:
+                    out.setdefault(table, set()).add(key)
+            return out
+
+
 class StateSnapshot:
     """An immutable point-in-time view. All read methods of StateStore are
     defined on this class; the store itself reads through a live view."""
 
-    def __init__(self, tables: _Tables, index: int):
+    def __init__(self, tables: _Tables, index: int, journal=None):
         self._t = tables
         self.index = index
+        self.journal = journal
 
     # -- namespaces --------------------------------------------------------
     def namespace_by_name(self, name: str):
@@ -280,7 +322,7 @@ class StateStore(StateSnapshot):
         self._frozen: set[str] = set()
         self._latest_index = 0
         self._listeners: list[Callable[[str, int], None]] = []
-        super().__init__(_Tables(), 0)
+        super().__init__(_Tables(), 0, journal=ChangeJournal())
 
     # -- snapshot machinery ----------------------------------------------
     @property
@@ -291,7 +333,9 @@ class StateStore(StateSnapshot):
         """Freeze current tables; writers copy-on-first-write after this."""
         with self._lock:
             self._frozen = set(_Tables.TABLE_NAMES)
-            return StateSnapshot(self._shallow_tables(), self._latest_index)
+            return StateSnapshot(
+                self._shallow_tables(), self._latest_index, journal=self.journal
+            )
 
     def _shallow_tables(self) -> _Tables:
         t = _Tables.__new__(_Tables)
@@ -371,11 +415,13 @@ class StateStore(StateSnapshot):
             if not node.computed_class:
                 node.compute_class()
             nodes[node.id] = node
+            self.journal.note(index, "nodes", node.id)
             self._bump(index, "nodes")
 
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
             self._own("nodes").pop(node_id, None)
+            self.journal.note(index, "nodes", node_id)
             self._bump(index, "nodes")
 
     def update_node_status(self, index: int, node_id: str, status: str) -> None:
@@ -390,6 +436,7 @@ class StateStore(StateSnapshot):
             n2.status = status
             n2.modify_index = index
             nodes[node_id] = n2
+            self.journal.note(index, "nodes", node_id)
             self._bump(index, "nodes")
 
     def update_node_eligibility(self, index: int, node_id: str, elig: str) -> None:
@@ -404,6 +451,7 @@ class StateStore(StateSnapshot):
             n2.scheduling_eligibility = elig
             n2.modify_index = index
             nodes[node_id] = n2
+            self.journal.note(index, "nodes", node_id)
             self._bump(index, "nodes")
 
     def update_node_drain(
@@ -428,6 +476,7 @@ class StateStore(StateSnapshot):
             )
             n2.modify_index = index
             nodes[node_id] = n2
+            self.journal.note(index, "nodes", node_id)
             self._bump(index, "nodes")
 
     # -- jobs -------------------------------------------------------------
@@ -556,12 +605,14 @@ class StateStore(StateSnapshot):
                     a.client_status = existing.client_status
                 if existing.node_id and existing.node_id != a.node_id:
                     self._idx_del(by_node, existing.node_id, a.id)
+                    self.journal.note(index, "node_allocs", existing.node_id)
             else:
                 a.create_index = index
             a.modify_index = index
             table[a.id] = a
             if a.node_id:
                 self._idx_add(by_node, a.node_id, a.id)
+                self.journal.note(index, "node_allocs", a.node_id)
             self._idx_add(by_job, (a.namespace, a.job_id), a.id)
 
     def delete_allocs(self, index: int, alloc_ids: Iterable[str]) -> None:
@@ -574,6 +625,7 @@ class StateStore(StateSnapshot):
                 if a is not None:
                     if a.node_id:
                         self._idx_del(by_node, a.node_id, aid)
+                        self.journal.note(index, "node_allocs", a.node_id)
                     self._idx_del(by_job, (a.namespace, a.job_id), aid)
             self._bump(index, "allocs")
 
@@ -606,6 +658,8 @@ class StateStore(StateSnapshot):
                 a.task_states = upd.task_states or a.task_states
                 a.modify_index = index
                 table[a.id] = a
+                if a.node_id:
+                    self.journal.note(index, "node_allocs", a.node_id)
             self._bump(index, "allocs")
 
     # -- deployments -------------------------------------------------------

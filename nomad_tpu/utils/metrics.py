@@ -32,8 +32,8 @@ class Metrics:
         with self._lock:
             buf = self._samples.setdefault(name, [])
             buf.append(seconds)
-            if len(buf) > 1024:
-                del buf[: len(buf) - 1024]
+            if len(buf) > 8192:
+                del buf[: len(buf) - 8192]
 
     @contextmanager
     def timer(self, name: str):
@@ -43,21 +43,37 @@ class Metrics:
         finally:
             self.measure(name, time.perf_counter() - t0)
 
+    @staticmethod
+    def _pct(sorted_buf: list[float], q: float) -> float:
+        if not sorted_buf:
+            return 0.0
+        i = min(len(sorted_buf) - 1, int(round(q * (len(sorted_buf) - 1))))
+        return sorted_buf[i]
+
     def snapshot(self) -> dict:
         with self._lock:
-            samples = {
-                name: {
+            samples = {}
+            for name, buf in self._samples.items():
+                s = sorted(buf)
+                samples[name] = {
                     "count": len(buf),
                     "mean_ms": (sum(buf) / len(buf)) * 1000 if buf else 0.0,
-                    "max_ms": max(buf) * 1000 if buf else 0.0,
+                    "p50_ms": self._pct(s, 0.50) * 1000,
+                    "p95_ms": self._pct(s, 0.95) * 1000,
+                    "p99_ms": self._pct(s, 0.99) * 1000,
+                    "max_ms": s[-1] * 1000 if s else 0.0,
                 }
-                for name, buf in self._samples.items()
-            }
             return {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "samples": samples,
             }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._samples.clear()
 
 
 global_metrics = Metrics()

@@ -1,0 +1,117 @@
+"""Batched multi-eval scheduling (SURVEY.md §7 step 5): many pending
+evals packed into one device pass, replacing the reference's
+worker-per-core concurrency (nomad/worker.go:85, nomad/config.go:468).
+"""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.broker.eval_broker import EvalBroker
+from nomad_tpu.server import Server, ServerConfig
+
+
+def _ev(job_id="j1", ns="default", typ="service", prio=50):
+    e = mock.eval_for(mock.job(id=job_id, priority=prio))
+    e.namespace = ns
+    e.type = typ
+    return e
+
+
+class TestDequeueMany:
+    def test_returns_up_to_max(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        for i in range(5):
+            b.enqueue(_ev(job_id=f"j{i}"))
+        got = b.dequeue_many(["service"], 3, timeout=1)
+        assert len(got) == 3
+        got2 = b.dequeue_many(["service"], 10, timeout=0.2)
+        assert len(got2) == 2
+
+    def test_per_job_serialization_within_batch(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        b.enqueue(_ev(job_id="same"))
+        b.enqueue(_ev(job_id="same"))
+        b.enqueue(_ev(job_id="other"))
+        got = b.dequeue_many(["service"], 10, timeout=1)
+        jobs = [ev.job_id for ev, _ in got]
+        assert sorted(jobs) == ["other", "same"]  # second 'same' deferred
+        for ev, tok in got:
+            b.ack(ev.id, tok)
+        got2 = b.dequeue_many(["service"], 10, timeout=1)
+        assert [ev.job_id for ev, _ in got2] == ["same"]
+
+    def test_nonblocking_poll(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        ev, tok = b.dequeue(["service"], timeout=0)
+        assert ev is None
+
+
+class TestBatchedScheduling:
+    def test_burst_of_jobs_all_placed(self):
+        """A burst of registrations drains through the batched pass with
+        every allocation placed and every eval completed."""
+        s = Server(ServerConfig(num_workers=2))
+        s.establish_leadership()
+        try:
+            for _ in range(10):
+                s.register_node(mock.node())
+            # 10 nodes × ⌊3900/500⌋ = 70 slots; ask for 60
+            jobs = []
+            for i in range(20):
+                j = mock.job(id=f"burst-{i}")
+                j.task_groups[0].count = 3
+                jobs.append(j)
+                s.register_job(j)
+            assert s.wait_for_evals(timeout=60)
+            for j in jobs:
+                live = [
+                    a
+                    for a in s.store.allocs_by_job(j.namespace, j.id)
+                    if not a.terminal_status()
+                ]
+                assert len(live) == 3, f"{j.id}: {len(live)}"
+            # every eval completed
+            for j in jobs:
+                evs = s.store.evals_by_job(j.namespace, j.id)
+                assert evs and all(e.status == "complete" for e in evs)
+        finally:
+            s.shutdown()
+
+    def test_batch_conflict_falls_back_and_converges(self):
+        """Evals in one batch score against the same snapshot, so they can
+        jointly overcommit a node; the applier partially rejects and the
+        fallback path converges (the optimistic-concurrency contract,
+        plan_apply.go:439-596)."""
+        s = Server(ServerConfig(num_workers=2))
+        s.establish_leadership()
+        try:
+            # one node with room for exactly 6 × 500 MHz (4000 - 100
+            # reserved → 7×500=3500 fits, 8 doesn't)
+            s.register_node(mock.node())
+            jobs = []
+            for i in range(8):
+                j = mock.job(id=f"tight-{i}")
+                j.task_groups[0].count = 1
+                jobs.append(j)
+                s.register_job(j)
+            assert s.wait_for_evals(timeout=60)
+            placed = sum(
+                1
+                for j in jobs
+                for a in s.store.allocs_by_job(j.namespace, j.id)
+                if not a.terminal_status()
+            )
+            assert placed == 7, f"placed {placed}"
+            # the rest are blocked, not lost
+            blocked = [
+                e
+                for j in jobs
+                for e in s.store.evals_by_job(j.namespace, j.id)
+                if e.status == "blocked"
+            ]
+            assert blocked
+        finally:
+            s.shutdown()
