@@ -141,12 +141,14 @@ class EvalBroker:
 
     # -- dequeue -----------------------------------------------------------
     def dequeue(
-        self, schedulers: list[str], timeout: float = 0.0
+        self, schedulers: list[str], timeout: Optional[float] = None
     ) -> tuple[Optional[Evaluation], str]:
         """Blocking dequeue for the given scheduler types. Returns
-        (eval, token) or (None, "") on timeout/disable. ``timeout=0`` is
-        a non-blocking poll."""
-        deadline = time.time() + timeout
+        (eval, token) or (None, "") on timeout/disable. ``timeout=None``
+        blocks until an eval arrives (the reference's blocking
+        Eval.Dequeue RPC, nomad/eval_broker.go); ``timeout=0`` is an
+        explicit non-blocking poll."""
+        deadline = None if timeout is None else time.time() + timeout
         with self._lock:
             while True:
                 if not self.enabled:
@@ -183,13 +185,16 @@ class EvalBroker:
                         self._delivery_count.get(ev.id, 0) + 1
                     )
                     return ev, token
+                if deadline is None:
+                    self._lock.wait(min(next_delay, 1.0))
+                    continue
                 remaining = deadline - time.time()
                 if remaining <= 0:
                     return None, ""
                 self._lock.wait(min(remaining, next_delay, 1.0))
 
     def dequeue_many(
-        self, schedulers: list[str], max_n: int, timeout: float = 0.0
+        self, schedulers: list[str], max_n: int, timeout: Optional[float] = None
     ) -> list[tuple[Evaluation, str]]:
         """Dequeue up to ``max_n`` ready evals in one call — the intake of
         the batched multi-eval device pass (SURVEY.md §7 step 5). The

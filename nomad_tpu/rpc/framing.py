@@ -129,10 +129,13 @@ class _RestrictedUnpickler(pickle.Unpickler):
         if (module, name) in _SAFE_GLOBALS:
             return super().find_class(module, name)
         # Framework types: classes from the fixed struct-module set only.
-        # pickle never calls __init__ when materializing these (object
-        # construction goes through cls.__new__ + state assignment), and
-        # functions can never resolve — no callable an attacker can
-        # invoke with chosen arguments.
+        # NOTE the actual invariant: functions never resolve, but the
+        # allowlisted CLASSES remain callable with attacker-chosen args —
+        # pickle's REDUCE opcode invokes cls(*args), running __init__.
+        # Safety therefore rests on every allowlisted class being a
+        # side-effect-free data class (keep it that way when extending
+        # _SAFE_MODULES; a class whose __init__ touches files/sockets/
+        # subprocesses would reopen a gadget).
         if module in _SAFE_MODULES:
             try:
                 mod = importlib.import_module(module)

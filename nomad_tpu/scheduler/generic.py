@@ -140,12 +140,18 @@ class GenericScheduler:
         return self._submit_attempt()
 
     # -- batched multi-eval pass (SURVEY.md §7 step 5) --------------------
-    def prepare_batch_attempt(self, evaluation: Evaluation):
+    def prepare_batch_attempt(self, evaluation: Evaluation, ct=None):
         """Phase A of a batched multi-eval device pass: run the host side
         (reconcile + flatten) and return this eval's group asks for the
         caller to merge into one kernel call across evals — the batch
         dimension replacing the reference's worker-per-core concurrency
         (nomad/worker.go:85, SURVEY.md §2.7).
+
+        ``ct`` is the batch-shared ClusterTensors the caller fetched ONCE
+        for the whole batch: every eval's masks must be built against the
+        same row order as the capacity/used arrays of the combined kernel
+        call (a mid-batch cache-generation advance would otherwise hand
+        later evals a differently-ordered transient build).
 
         Returns the list of GroupAsks, or None when the eval needs the
         individual path: no placement work at all, or a plan whose
@@ -162,7 +168,7 @@ class GenericScheduler:
             return None
         if self.plan.node_update or self.plan.node_preemptions:
             return None  # evictions free capacity only for this eval's plan
-        ct, tg_order = self._build_group_asks(placements)
+        ct, tg_order = self._build_group_asks(placements, ct=ct)
         self._batch_ctx = (ct, tg_order)
         return [t[3] for t in tg_order]
 
@@ -318,12 +324,14 @@ class GenericScheduler:
         return True, False
 
     # -- placement via the device kernel ---------------------------------
-    def _build_group_asks(self, placements):
+    def _build_group_asks(self, placements, ct=None):
         """Flatten this eval's placements into dense group asks against
         the resident tensors (replaces computePlacements' per-alloc
-        stack.Select walk). Returns (ct, tg_order)."""
+        stack.Select walk). Returns (ct, tg_order). ``ct`` lets a batch
+        caller supply one shared tensors object for all evals."""
         snap = self.snapshot
-        ct = self.cache.tensors(snap)
+        if ct is None:
+            ct = self.cache.tensors(snap)
         nodes_sorted = ct.nodes
         # overlay this plan's own stops (evicted allocs free capacity)
         for node_id, stops in self.plan.node_update.items():

@@ -78,10 +78,23 @@ class Worker:
                 )
             if not batch:
                 continue
-            if len(batch) == 1:
-                self._run_one(*batch[0])
-            else:
-                self._run_batch(batch)
+            try:
+                if len(batch) == 1:
+                    self._run_one(*batch[0])
+                else:
+                    self._run_batch(batch)
+            except Exception:
+                # a worker thread must never die silently: dequeued evals
+                # would stay unacked forever and per-job serialization
+                # would wedge those jobs (the broker has no redelivery
+                # deadline). Nack everything still outstanding.
+                log.exception("worker %d: batch failed", self.id)
+                for ev, token in batch:
+                    try:
+                        self.server.eval_broker.nack(ev.id, token)
+                        self.stats["nacked"] += 1
+                    except ValueError:
+                        pass  # already acked/nacked
 
     def _run_one(self, ev: Evaluation, token: str) -> None:
         self._eval_token = token
@@ -97,6 +110,9 @@ class Worker:
                 pass
             self.stats["nacked"] += 1
         self.stats["processed"] += 1
+        # per-eval counter: the invoke_scheduler TIMER emits one sample per
+        # batched pass, so throughput accounting reads this counter instead
+        metrics.incr("nomad.worker.evals_processed")
 
     def _run_batch(self, batch: list[tuple[Evaluation, str]]) -> None:
         """Process a batch of evals through one combined device pass.
@@ -107,6 +123,13 @@ class Worker:
                 max(ev.modify_index for ev, _ in batch), timeout=5.0
             )
         snapshot = self.server.store.snapshot()
+        # One ClusterTensors for the WHOLE batch: if each scheduler fetched
+        # its own, a concurrent worker advancing the cache generation
+        # mid-batch would hand later schedulers a transient build whose row
+        # order differs (sorted-by-id vs incremental append) — their masks
+        # would silently misalign with the capacity/used arrays in the
+        # combined kernel call.
+        ct = self.server.device_cache.tensors(snapshot)
 
         prepared = []  # (ev, token, sched, n_asks)
         all_asks: list = []
@@ -120,7 +143,7 @@ class Worker:
                 ev.type, snapshot, self, cache=self.server.device_cache
             )
             try:
-                asks = sched.prepare_batch_attempt(ev)
+                asks = sched.prepare_batch_attempt(ev, ct=ct)
             except Exception:
                 log.exception("worker %d: batch prepare %s", self.id, ev.id)
                 asks = None
@@ -129,14 +152,22 @@ class Worker:
             if asks is None:
                 singles.append((ev, token))
             else:
+                assert sched._batch_ctx[0] is ct
                 prepared.append((ev, token, sched, len(asks)))
                 all_asks.extend(asks)
 
-        results = []
+        results = None
         if all_asks:
-            ct = prepared[0][2]._batch_ctx[0]
-            with metrics.timer("nomad.worker.invoke_scheduler"):
-                results = prepared[0][2].kernel.place(ct, all_asks)
+            try:
+                with metrics.timer("nomad.worker.invoke_scheduler"):
+                    results = prepared[0][2].kernel.place(ct, all_asks)
+            except Exception:
+                # shared pass failed — every prepared eval falls back to
+                # the individual path rather than dying unacked
+                log.exception("worker %d: combined kernel pass", self.id)
+                metrics.incr("nomad.worker.batch_kernel_errors")
+                singles.extend((ev, token) for ev, token, _, _ in prepared)
+                prepared = []
 
         off = 0
         for ev, token, sched, n in prepared:
@@ -148,8 +179,11 @@ class Worker:
                     self.server.eval_broker.ack(ev.id, token)
                     self.stats["acked"] += 1
                     self.stats["processed"] += 1
+                    metrics.incr("nomad.worker.batch_evals_completed")
+                    metrics.incr("nomad.worker.evals_processed")
                 else:
                     # optimistic conflict: re-run individually on fresh state
+                    metrics.incr("nomad.worker.batch_conflict_fallbacks")
                     singles.append((ev, token))
             except Exception:
                 log.exception("worker %d: batch complete %s", self.id, ev.id)
@@ -159,8 +193,10 @@ class Worker:
                     pass
                 self.stats["nacked"] += 1
                 self.stats["processed"] += 1
+                metrics.incr("nomad.worker.evals_processed")
 
         for ev, token in singles:
+            metrics.incr("nomad.worker.batch_single_fallbacks")
             self._run_one(ev, token)
 
     def process_eval(self, ev: Evaluation) -> None:
