@@ -117,12 +117,6 @@ def build_asks(ct, n_jobs: int, count_per_job: int, seed: int = 7):
                 affinity_scores=np.zeros(pn, dtype=np.float32),
                 has_affinities=False,
                 distinct_hosts=False,
-                spread_value_ids=np.full(pn, -1, dtype=np.int32),
-                spread_desired=np.zeros(1, dtype=np.float32),
-                spread_initial_counts=np.zeros(1, dtype=np.float32),
-                spread_weight=0.0,
-                has_spreads=False,
-                num_spread_values=1,
             )
         )
     return asks

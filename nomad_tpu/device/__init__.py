@@ -1,15 +1,31 @@
 """Device layer: cluster flattening + compiled placement/score kernels."""
 
-from .flatten import ClusterTensors, GroupAsk, flatten_cluster, flatten_group_ask
-from .score import PlacementKernel, PlacementResult, place_batch_kernel, score_matrix_kernel
+from .flatten import (
+    ClusterTensors,
+    GroupAsk,
+    ValueBlocks,
+    flatten_cluster,
+    flatten_group_ask,
+)
+from .score import (
+    PlacementKernel,
+    PlacementResult,
+    place_closed_form_kernel,
+    place_value_scan_kernel,
+    repair_batch_conflicts,
+    score_matrix_kernel,
+)
 
 __all__ = [
     "ClusterTensors",
     "GroupAsk",
+    "ValueBlocks",
     "flatten_cluster",
     "flatten_group_ask",
     "PlacementKernel",
     "PlacementResult",
-    "place_batch_kernel",
+    "place_closed_form_kernel",
+    "place_value_scan_kernel",
+    "repair_batch_conflicts",
     "score_matrix_kernel",
 ]

@@ -160,6 +160,78 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
 
 
 @dataclass
+class ValueBlocks:
+    """Stacked per-attribute-value accounting blocks for one group ask.
+
+    Spread blocks (scored — scheduler/spread.go) and distinct_property
+    blocks (capped — scheduler/feasible.go:604) share the same shape: a
+    per-node value-id column plus per-value state the kernel carries
+    through its placement scan. ``kinds[b]`` selects the semantics
+    (score.py BLOCK_* constants)."""
+
+    value_ids: np.ndarray  # i32[B, N]  (−1 = node has no value)
+    counts0: np.ndarray  # f32[B, V] initial combined-use counts
+    desired: np.ndarray  # f32[B, V] target-mode desired; −1 = untargeted
+    caps: np.ndarray  # f32[B, V] distinct_property allowed-count; +inf else
+    weights: np.ndarray  # f32[B] target-mode relative weight (w / Σw)
+    kinds: np.ndarray  # i32[B] BLOCK_TARGET_SPREAD/EVEN_SPREAD/DISTINCT_CAP
+
+    @property
+    def num_blocks(self) -> int:
+        return self.value_ids.shape[0]
+
+    @property
+    def num_values(self) -> int:
+        return self.counts0.shape[1]
+
+    @property
+    def has_spreads(self) -> bool:
+        from .score import BLOCK_DISTINCT_CAP
+
+        return bool((self.kinds != BLOCK_DISTINCT_CAP).any())
+
+
+def pad_value_blocks(blocks: list, pn: int) -> dict:
+    """Stack per-ask ValueBlocks (or None) into the padded [G, B, N] /
+    [G, B, V] kernel tensors, bucketing B and V to powers of two."""
+    from .score import BLOCK_INACTIVE
+
+    def bucket(n: int) -> int:
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
+    max_b = bucket(max([b.num_blocks for b in blocks if b is not None] or [1]))
+    max_v = bucket(max([b.num_values for b in blocks if b is not None] or [1]))
+    g = len(blocks)
+    value_ids = np.full((g, max_b, pn), -1, dtype=np.int32)
+    counts0 = np.zeros((g, max_b, max_v), dtype=np.float32)
+    desired = np.full((g, max_b, max_v), -1.0, dtype=np.float32)
+    caps = np.full((g, max_b, max_v), np.inf, dtype=np.float32)
+    weights = np.zeros((g, max_b), dtype=np.float32)
+    kinds = np.full((g, max_b), BLOCK_INACTIVE, dtype=np.int32)
+    for gi, b in enumerate(blocks):
+        if b is None:
+            continue
+        nb, nv = b.num_blocks, b.num_values
+        value_ids[gi, :nb, : b.value_ids.shape[1]] = b.value_ids
+        counts0[gi, :nb, :nv] = b.counts0
+        desired[gi, :nb, :nv] = b.desired
+        caps[gi, :nb, :nv] = b.caps
+        weights[gi, :nb] = b.weights
+        kinds[gi, :nb] = b.kinds
+    return dict(
+        block_value_ids=value_ids,
+        block_counts0=counts0,
+        block_desired=desired,
+        block_caps=caps,
+        block_weights=weights,
+        block_kinds=kinds,
+    )
+
+
+@dataclass
 class GroupAsk:
     """One task group's flattened placement request — everything the device
     kernel needs, with strings already resolved to masks/ids."""
@@ -175,15 +247,9 @@ class GroupAsk:
     affinity_scores: np.ndarray  # f32[N] pre-normalized [-1, 1]
     has_affinities: bool
     distinct_hosts: bool
-    # spread: node → value-id of the (single merged) spread attribute;
-    # -1 where the node has no value. Multiple spread blocks are summed
-    # host-side into one per-node boost-rate pair (see spread_* below).
-    spread_value_ids: np.ndarray  # i32[N]
-    spread_desired: np.ndarray  # f32[V] desired count per value id
-    spread_initial_counts: np.ndarray  # f32[V] existing usage per value id
-    spread_weight: float
-    has_spreads: bool
-    num_spread_values: int
+    # spread + distinct_property accounting blocks; None when the group
+    # has neither (→ the closed-form top-k path)
+    blocks: ValueBlocks | None = None
     # Per-node cap on additional placements of this group, from device
     # instance accounting (scheduler/device.py feasible_sets); None when
     # the group asks for no devices (kernel substitutes +inf).
@@ -191,6 +257,10 @@ class GroupAsk:
     # AllocMetric filter accounting (structs.go AllocMetric): populated by
     # _eligibility_for_group, surfaced on placement failures.
     filter_stats: dict = field(default_factory=dict)
+
+    @property
+    def has_spreads(self) -> bool:
+        return self.blocks is not None and self.blocks.has_spreads
 
 
 def _eligibility_for_group(
@@ -333,45 +403,177 @@ def _affinity_scores(ct, nodes_sorted, job: Job, tg: TaskGroup) -> tuple[np.ndar
     return scores / total, True
 
 
-def _spread_tensors(ct, nodes_sorted, job: Job, tg: TaskGroup, snap, total_desired):
-    """Merge the group's spread blocks into per-node value ids + per-value
-    desired counts (scheduler/spread.go:110-257). With explicit targets the
-    desired count is percent×total; without, even spread over seen values."""
+IMPLICIT_SPREAD_TARGET = "*"  # scheduler/spread.go:10
+
+
+def _combined_counts_vector(pset, vocab):
+    """Flatten a PropertySet's combined-use map onto value ids. Values
+    used by allocations but carried by no current node (e.g. only on a
+    removed node) get *phantom* slots appended past the node vocab so
+    even-spread min/max still sees them."""
+    combined = pset.combined_use()
+    extra = {v: n for v, n in combined.items() if v not in vocab}
+    nv = len(vocab) + len(extra)
+    counts = np.zeros(max(nv, 1), dtype=np.float32)
+    ids = dict(vocab)
+    for v, n in combined.items():
+        if v in ids:
+            counts[ids[v]] = n
+        else:
+            ids[v] = len(ids)
+            counts[ids[v]] = n
+    return counts, ids
+
+
+def _value_blocks(
+    ct, job: Job, tg: TaskGroup, snap, plan, total_desired, eligible, filter_stats
+):
+    """Build the group's stacked spread + distinct_property blocks.
+
+    Spread (scheduler/spread.go:232-257 computeSpreadInfo): per block,
+    desired[v] = percent/100 x tg.count for explicit targets; the
+    remaining count goes to the implicit ``*`` target when explicit
+    targets cover only part of the total; values with neither get -1
+    (flat penalty). Block weight is weight/sum(weights) — relative across
+    blocks, 1.0 for a single block (spread.go:155-161).
+
+    distinct_property (feasible.go:604-707): job-level constraints count
+    allocs of the whole job, task-group-level only this group's; nodes
+    missing the property are hard-filtered here (UsedCount errors), and
+    the per-value allowed-count cap is enforced dynamically in-kernel.
+    """
+    from ..scheduler.propertyset import PropertySet
+    from .score import (
+        BLOCK_DISTINCT_CAP,
+        BLOCK_EVEN_SPREAD,
+        BLOCK_TARGET_SPREAD,
+    )
+
     spreads = job.spreads_for_group(tg)
-    pn = ct.padded_n
-    if not spreads:
-        return (
-            np.full(pn, -1, dtype=np.int32),
-            np.zeros(1, dtype=np.float32),
-            np.zeros(1, dtype=np.float32),
-            0.0,
-            False,
-            1,
+    distinct_job = [
+        c for c in job.constraints if c.operand == "distinct_property"
+    ]
+    distinct_tg = [
+        c
+        for c in list(tg.constraints)
+        + [c for t in tg.tasks for c in t.constraints]
+        if c.operand == "distinct_property"
+    ]
+    if not spreads and not distinct_job and not distinct_tg:
+        return None
+
+    cols = []
+    counts_l = []
+    desired_l = []
+    caps_l = []
+    weights_l = []
+    kinds_l = []
+
+    def build_pset(attribute, scope, allowed=0):
+        p = PropertySet(
+            namespace=job.namespace,
+            job_id=job.id,
+            attribute=attribute,
+            task_group=scope,
+            allowed_count=allowed,
         )
-    # Round 1: support one spread attribute (merged weight); multi-block
-    # spreads are scored against the first block. TODO(round2): stack
-    # value-id planes per block and sum boosts in-kernel.
-    sp = spreads[0]
-    node_vals, value_ids = ct.attr_column(sp.attribute)
-    nv = max(len(value_ids), 1)
-    desired = np.zeros(nv, dtype=np.float32)
-    if sp.targets:
-        for t in sp.targets:
-            vid = value_ids.get(t.value)
-            if vid is not None:
-                desired[vid] = np.ceil(t.percent / 100.0 * total_desired)
-    else:
-        desired[:] = np.ceil(total_desired / nv)
-    counts = np.zeros(nv, dtype=np.float32)
-    if snap is not None:
-        for a in snap.allocs_by_job(job.namespace, job.id):
-            if a.terminal_status() or a.task_group != tg.name:
-                continue
-            row = ct.node_row.get(a.node_id)
-            if row is not None and node_vals[row] >= 0:
-                counts[node_vals[row]] += 1
-    weight = float(sp.weight) / 100.0
-    return node_vals, desired, counts, weight, True, nv
+        return p.populate(snap, plan) if snap is not None else p
+
+    sum_weights = float(sum(sp.weight for sp in spreads)) or 1.0
+    for sp in spreads:
+        node_vals, vocab = ct.attr_column(sp.attribute)
+        pset = build_pset(sp.attribute, tg.name)
+        counts, ids = _combined_counts_vector(pset, vocab)
+        nv = counts.shape[0]
+        desired = np.full(nv, -1.0, dtype=np.float32)
+        if sp.targets:
+            explicit_sum = 0.0
+            implicit = None
+            for t in sp.targets:
+                d = t.percent / 100.0 * total_desired
+                explicit_sum += d
+                if t.value == IMPLICIT_SPREAD_TARGET:
+                    implicit = d
+                    continue
+                vid = ids.get(t.value)
+                if vid is not None:
+                    desired[vid] = d
+            if 0 < explicit_sum < total_desired:
+                implicit = total_desired - explicit_sum
+            if implicit is not None:
+                # untargeted values inherit the implicit target's desired
+                # count (spread.go:145-149)
+                explicit_vids = {
+                    ids[t.value]
+                    for t in sp.targets
+                    if t.value in ids and t.value != IMPLICIT_SPREAD_TARGET
+                }
+                for vid in range(nv):
+                    if vid not in explicit_vids:
+                        desired[vid] = implicit
+            kinds_l.append(BLOCK_TARGET_SPREAD)
+        else:
+            kinds_l.append(BLOCK_EVEN_SPREAD)
+        cols.append(node_vals)
+        counts_l.append(counts)
+        desired_l.append(desired)
+        caps_l.append(np.full(nv, np.inf, dtype=np.float32))
+        weights_l.append(float(sp.weight) / sum_weights)
+
+    for c, scope in [(c, "") for c in distinct_job] + [
+        (c, tg.name) for c in distinct_tg
+    ]:
+        node_vals, vocab = ct.attr_column(c.l_target)
+        try:
+            allowed = int(c.r_target) if c.r_target else 1
+        except ValueError:
+            # unparsable allowed-count: constraint can never pass
+            # (propertyset.go:88-95 errorBuilding)
+            eligible[:] = False
+            filter_stats.setdefault("constraint_filtered", {})[
+                f"distinct_property: bad count {c.r_target!r}"
+            ] = int(ct.num_nodes)
+            continue
+        pset = build_pset(c.l_target, scope, allowed)
+        counts, ids = _combined_counts_vector(pset, vocab)
+        nv = counts.shape[0]
+        # nodes missing the property are infeasible (UsedCount error path)
+        missing = (node_vals < 0) & eligible
+        n_missing = int(missing[: ct.num_nodes].sum())
+        if n_missing:
+            eligible &= node_vals >= 0
+            cf = filter_stats.setdefault("constraint_filtered", {})
+            reason = f'missing property "{c.l_target}"'
+            cf[reason] = cf.get(reason, 0) + n_missing
+            filter_stats["nodes_filtered"] = (
+                filter_stats.get("nodes_filtered", 0) + n_missing
+            )
+        cols.append(node_vals)
+        counts_l.append(counts)
+        desired_l.append(np.full(nv, -1.0, dtype=np.float32))
+        caps_l.append(np.full(nv, float(allowed), dtype=np.float32))
+        weights_l.append(0.0)
+        kinds_l.append(BLOCK_DISTINCT_CAP)
+
+    nb = len(cols)
+    max_v = max(c.shape[0] for c in counts_l)
+    value_ids = np.stack(cols)  # [B, N] — all share pn
+    counts0 = np.zeros((nb, max_v), dtype=np.float32)
+    desired = np.full((nb, max_v), -1.0, dtype=np.float32)
+    caps = np.full((nb, max_v), np.inf, dtype=np.float32)
+    for b in range(nb):
+        nv = counts_l[b].shape[0]
+        counts0[b, :nv] = counts_l[b]
+        desired[b, :nv] = desired_l[b]
+        caps[b, :nv] = caps_l[b]
+    return ValueBlocks(
+        value_ids=value_ids,
+        counts0=counts0,
+        desired=desired,
+        caps=caps,
+        weights=np.array(weights_l, dtype=np.float32),
+        kinds=np.array(kinds_l, dtype=np.int32),
+    )
 
 
 def _device_slot_caps(
@@ -440,8 +642,11 @@ def flatten_group_ask(
     *,
     nodes_sorted=None,
     penalty_node_ids: set[str] | None = None,
+    plan=None,
 ) -> GroupAsk:
-    """Flatten one (job, task group, count) placement request."""
+    """Flatten one (job, task group, count) placement request. ``plan``
+    (when given) feeds proposed/cleared allocations into the spread and
+    distinct_property property sets (propertyset.go:163-208)."""
     if nodes_sorted is None:
         # row-ordered node objects from the tensors themselves; falling
         # back to a sort only for hand-built ClusterTensors without them
@@ -487,8 +692,8 @@ def flatten_group_ask(
         # (rank.go:388-434 adds the assignment's affinity sum to the score)
         aff = (aff + dev_aff) / (2.0 if has_aff else 1.0)
         has_aff = True
-    sp_vals, sp_desired, sp_counts, sp_w, has_sp, nv = _spread_tensors(
-        ct, nodes_sorted, job, tg, snap, tg.count
+    blocks = _value_blocks(
+        ct, job, tg, snap, plan, tg.count, eligible, filter_stats
     )
 
     distinct = any(
@@ -507,12 +712,7 @@ def flatten_group_ask(
         affinity_scores=aff,
         has_affinities=has_aff,
         distinct_hosts=distinct,
-        spread_value_ids=sp_vals,
-        spread_desired=sp_desired,
-        spread_initial_counts=sp_counts,
-        spread_weight=sp_w,
-        has_spreads=has_sp,
-        num_spread_values=nv,
+        blocks=blocks,
         slot_caps=slot_caps,
         filter_stats=filter_stats,
     )

@@ -6,23 +6,30 @@ scheduler/rank.go:193-527 BinPackIterator.Next): walk up to ``limit`` nodes
 through ~10 iterator stages, computing fit and score sequentially in Go.
 O(allocs × limit × stages), single-threaded per eval.
 
-What this module does instead: one compiled XLA program per shape bucket
-computing, for a *batch* of task groups at once::
+What this module does instead: ONE fully-parallel scoring pass per group
+batch. For a group placing ``count`` identical asks, every candidate
+"place the (j+1)-th instance of this group on node n" has a closed-form
+score — usage is used0 + (j+1)·ask, collisions are jc0 + j — so the whole
+candidate space is a dense [N, J] plane computed in one shot
+(``_score_planes``). Two selection paths consume the planes:
 
-    scores[g, n] = mean(binpack, anti_affinity, resched_penalty,
-                        affinity, spread)[g, n]        (masked -inf infeasible)
+- **Closed-form top-k** (groups with no cross-node coupling): per-node
+  score columns are made monotone by a running-min clamp, which turns
+  greedy placement into a single ``lax.top_k`` over the flattened plane.
+  One parallel pass replaces ``count`` sequential argmax steps.
 
-and a greedy placement *scan*: ``lax.scan`` over placement steps, each step
-argmax-ing the live score vector and updating the proposed-usage state on
-device — the exact greedy semantics of pulling the iterator chain to
-completion with limit = ∞ (the dense pass computes the true argmax, which
-the reference only approximates by sampling log₂(n) nodes; see SURVEY.md
-§7 "hard parts": parity metric is placement-score, not identity).
+- **Gather-scan** (groups whose spread blocks / distinct_property caps
+  couple nodes through global per-value counts): a ``lax.scan`` over
+  placement steps that does only O(N) *gather* work per step — the heads
+  of each node's precomputed column plus a [B, V] per-value boost table —
+  instead of rescoring every node against every resource dim. Exact
+  stepwise-greedy semantics at a fraction of the serial cost.
 
 Batch dimension = concurrent evals/groups, replacing Nomad's worker-per-
 core optimistic concurrency (nomad/worker.go:85): every group in a batch
-scores against the same snapshot, and conflicts are resolved by the plan
-applier exactly as for concurrent Go workers.
+scores against the same snapshot, and conflicts are resolved host-side by
+``repair_batch_conflicts`` (using each lane's overflow candidates) before
+the plan applier's authoritative re-check.
 
 Scoring component semantics (each cites its reference):
 - binpack/spread fit: nomad/structs/funcs.go:236-274, normalized /18
@@ -33,8 +40,13 @@ Scoring component semantics (each cites its reference):
   from (rank.go:606-648).
 - node affinity: weight-normalized Σ w·match / Σ|w| (rank.go:650-737),
   precomputed per node host-side (string matching ≪ scoring cost).
-- spread: (desired − used−1)/desired × weight/100 for the node's value of
-  the spread attribute (scheduler/spread.go:110-228).
+- spread (scheduler/spread.go:110-228): one component summing per-block
+  boosts. Target mode: (desired − used−1)/desired × weight/Σweights, −1
+  for untargeted values; even mode: the min/max-delta boost
+  (spread.go:178-228). The component joins the normalization mean only
+  when the total boost is nonzero (spread.go:168-171).
+- distinct_property (feasible.go:604-707): not a score — a dynamic
+  per-value cap carried through the scan's count state.
 - normalization: mean over *contributing* components
   (rank.go:740-767 ScoreNormalizationIterator).
 """
@@ -42,7 +54,7 @@ Scoring component semantics (each cites its reference):
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 import jax
@@ -52,6 +64,16 @@ import numpy as np
 from ..structs.resources import BINPACK_MAX_SCORE
 
 _LN10 = 2.302585092994046
+
+# value-block kinds (ValueBlocks.kinds; see flatten.py)
+BLOCK_TARGET_SPREAD = 0
+BLOCK_EVEN_SPREAD = 1
+BLOCK_DISTINCT_CAP = 2
+BLOCK_INACTIVE = -1
+
+# extra greedy candidates emitted beyond ``count`` per lane, consumed by
+# repair_batch_conflicts when optimistic batch lanes collide on a node
+OVERFLOW_CANDIDATES = 16
 
 
 def _pow10(x):
@@ -74,7 +96,9 @@ def component_scores(
     algorithm_spread,  # bool[] scheduler algorithm: binpack vs spread fit
 ):
     """Per-node normalized score for placing one instance of ``ask``.
-    Returns (final_score f32[N] with -inf infeasible, fits bool[N])."""
+    Returns (final_score f32[N] with -inf infeasible, fits bool[N]).
+    Used by the dense [G, N] score-matrix path (annotation, system
+    scheduler); the placement paths use the [N, J] planes instead."""
     proposed = used + ask  # [N, D]
     fits = jnp.all(proposed <= capacity, axis=-1) & eligible
     fits &= jnp.where(distinct_hosts, job_counts == 0, True)
@@ -93,110 +117,94 @@ def component_scores(
     )
     resched = jnp.where(penalty_nodes, -1.0, 0.0)
     aff = jnp.where(has_affinities, affinity_scores, 0.0)
-    spread_c = jnp.where(has_spreads, spread_boost, 0.0)
+    spread_on = has_spreads & (spread_boost != 0.0)
+    spread_c = jnp.where(spread_on, spread_boost, 0.0)
 
     n_comp = (
         1.0
         + (job_counts > 0)
         + penalty_nodes
         + jnp.where(has_affinities, 1.0, 0.0)
-        + jnp.where(has_spreads, 1.0, 0.0)
+        + jnp.where(spread_on, 1.0, 0.0)
     )
     total = fit_score + anti + resched + aff + spread_c
     final = total / n_comp
     return jnp.where(fits, final, -jnp.inf), fits
 
 
-def _spread_boost(spread_value_ids, spread_desired, spread_counts, spread_weight):
-    """Boost for adding one alloc to each node, given current per-value
-    counts. Nodes with no value for the attribute get 0."""
-    has_value = spread_value_ids >= 0
-    vid = jnp.maximum(spread_value_ids, 0)
-    desired = spread_desired[vid]
-    after = spread_counts[vid] + 1.0
-    boost = jnp.where(
-        desired > 0, (desired - after) / jnp.maximum(desired, 1.0), -1.0
-    ) * spread_weight
-    return jnp.where(has_value, boost, 0.0)
-
-
-def _place_scan(
-    capacity,
-    used0,
-    ask,
-    eligible,
-    job_counts0,
-    desired_total,
-    penalty_nodes,
-    affinity_scores,
-    has_affinities,
-    spread_value_ids,
-    spread_desired,
-    spread_counts0,
-    spread_weight,
-    has_spreads,
-    distinct_hosts,
-    slot_caps,  # f32[N] max additional placements per node (device sets)
-    algorithm_spread,
-    count,  # i32[] actual placements wanted (≤ max_steps)
-    max_steps: int,
+def _score_planes(
+    capacity,  # f32[N, D]
+    used0,  # f32[N, D]
+    ask,  # f32[D]
+    elig,  # bool[N]
+    jc0,  # i32[N]
+    dt,  # f32[] anti-affinity denominator
+    pen,  # bool[N]
+    aff,  # f32[N]
+    has_aff,  # bool[]
+    dh,  # bool[] distinct_hosts
+    caps,  # f32[N] per-node device-slot caps
+    algorithm_spread,  # bool[]
+    max_j: int,
 ):
-    """Greedy sequential placement of ``count`` identical asks.
+    """The shared [N, J] candidate planes: numerator (sum of non-spread
+    components), denominator (contributing-component count, spread
+    excluded — the scan adds it dynamically), and feasibility. Work in
+    [N, J] planes only — a [N, J, D] temp is N·J·D·4 bytes and OOMs at
+    40k-node scale; the D axis is tiny and static, so unroll it."""
+    js = jnp.arange(max_j, dtype=jnp.float32)  # [J]
+    mult = js[None, :] + 1.0  # [1, J]
+    fits = elig[:, None] & jnp.ones((1, max_j), dtype=bool)
+    for d in range(capacity.shape[1]):
+        prop_d = used0[:, d : d + 1] + mult * ask[d]
+        fits &= prop_d <= capacity[:, d : d + 1]
+    # distinct_hosts ⇒ only j=0 and only where no existing collision
+    dh_mask = jnp.where(dh, (js[None, :] == 0) & (jc0[:, None] == 0), True)
+    fits &= dh_mask
+    fits &= js[None, :] < caps[:, None]  # device-slot caps
 
-    Each step scores all nodes against the *current* proposed usage (the
-    device-resident analog of ProposedAllocs, scheduler/context.go:120-157),
-    picks the argmax, and folds the placement into the state. Steps past
-    ``count`` (or with no feasible node) emit choice −1. ``slot_caps``
-    bounds per-node placements of *this* group — the dense form of the
-    DeviceChecker/DeviceAccounter limit (scheduler/device.py).
-    """
-
-    def step(state, i):
-        used, job_counts, spread_counts, placed = state
-        boost = _spread_boost(
-            spread_value_ids, spread_desired, spread_counts, spread_weight
+    pow_sum = jnp.zeros_like(fits, dtype=jnp.float32)
+    for d in (0, 1):  # cpu, mem drive the fit score
+        cap_d = capacity[:, d : d + 1]
+        prop_d = used0[:, d : d + 1] + mult * ask[d]
+        free_d = jnp.where(
+            cap_d > 0, (cap_d - prop_d) / jnp.maximum(cap_d, 1e-9), 1.0
         )
-        final, _ = component_scores(
-            capacity,
-            used,
-            ask,
-            eligible & (placed < slot_caps),
-            job_counts,
-            desired_total,
-            penalty_nodes,
-            affinity_scores,
-            has_affinities,
-            boost,
-            has_spreads,
-            distinct_hosts,
-            algorithm_spread,
-        )
-        best = jnp.argmax(final)
-        best_score = final[best]
-        ok = (best_score > -jnp.inf) & (i < count)
-        choice = jnp.where(ok, best, -1)
-        onehot = (jnp.arange(used.shape[0]) == best) & ok
-        used = used + jnp.where(onehot[:, None], ask[None, :], 0.0)
-        job_counts = job_counts + onehot.astype(job_counts.dtype)
-        placed = placed + onehot.astype(placed.dtype)
-        vid = jnp.maximum(spread_value_ids[best], 0)
-        bump = ok & (spread_value_ids[best] >= 0)
-        spread_counts = spread_counts.at[vid].add(jnp.where(bump, 1.0, 0.0))
-        return (used, job_counts, spread_counts, placed), (
-            choice.astype(jnp.int32),
-            jnp.where(ok, best_score, -jnp.inf).astype(jnp.float32),
-        )
-
-    placed0 = jnp.zeros(used0.shape[0], dtype=jnp.float32)
-    state0 = (used0, job_counts0, spread_counts0, placed0)
-    (used, job_counts, spread_counts, _placed), (choices, scores) = jax.lax.scan(
-        step, state0, jnp.arange(max_steps)
+        pow_sum = pow_sum + _pow10(free_d)
+    binpack = jnp.clip(20.0 - pow_sum, 0.0, BINPACK_MAX_SCORE)
+    spread_fit = jnp.clip(pow_sum - 2.0, 0.0, BINPACK_MAX_SCORE)
+    fit_score = (
+        jnp.where(algorithm_spread, spread_fit, binpack) / BINPACK_MAX_SCORE
     )
-    return choices, scores, used
+
+    coll = jc0[:, None].astype(jnp.float32) + js[None, :]  # after j placed
+    has_coll = coll > 0
+    anti = jnp.where(has_coll, -(coll + 1.0) / jnp.maximum(dt, 1.0), 0.0)
+    resched = jnp.where(pen[:, None], -1.0, 0.0)
+    aff_c = jnp.where(has_aff, aff[:, None], 0.0)
+    num = fit_score + anti + resched + aff_c  # [N, J]
+    den = 1.0 + has_coll + pen[:, None] + jnp.where(has_aff, 1.0, 0.0)
+    return num, den, fits
 
 
-@functools.partial(jax.jit, static_argnames=("max_steps",))
-def place_batch_kernel(
+# -- closed-form greedy (the TPU-shaped fast path) ---------------------------
+#
+# For one group placing ``count`` IDENTICAL asks with no per-value
+# coupling, node scores are independent and the per-node score sequence
+# s[n, j] is monotone non-increasing in j after a running-min clamp
+# (binpack worsens with usage, anti-affinity grows; the single
+# non-monotone corner — a rising best-fit head — is flattened by the
+# clamp, under which top-k fills nodes in descending initial-score order,
+# exactly what stepwise greedy does with rising heads). Greedy placement
+# then equals a plain top-k over the flattened [N, J] matrix.
+#
+# This is the "batched dense score matrix" BASELINE.json names as the
+# north-star replacement for the reference's per-placement iterator walk
+# (scheduler/rank.go:193-527): O(N·J) parallel work, O(log) depth.
+
+
+@functools.partial(jax.jit, static_argnames=("max_j", "k"))
+def place_closed_form_kernel(
     capacity,  # f32[N, D] shared
     used0,  # f32[N, D] shared snapshot usage
     asks,  # f32[G, D]
@@ -206,62 +214,204 @@ def place_batch_kernel(
     penalty_nodes,  # bool[G, N]
     affinity_scores,  # f32[G, N]
     has_affinities,  # bool[G]
-    spread_value_ids,  # i32[G, N]
-    spread_desired,  # f32[G, V]
-    spread_counts,  # f32[G, V]
-    spread_weights,  # f32[G]
-    has_spreads,  # bool[G]
     distinct_hosts,  # bool[G]
-    slot_caps,  # f32[G, N] per-node device-set caps (+inf when no devices)
+    slot_caps,  # f32[G, N]
     algorithm_spread,  # bool[]
     counts,  # i32[G]
+    max_j: int,  # static: max instances of one group per node
+    k: int,  # static: top-k width (≥ max count in batch + overflow)
+):
+    """Returns (choices i32[G, k], scores f32[G, k]) in greedy order.
+    Entries past a lane's feasible candidates are −1/−inf; entries in
+    [count, k) are valid *overflow* candidates for conflict repair."""
+
+    def one_group(ask, elig, jc0, dt, pen, aff, has_aff, dh, caps, count):
+        num, den, fits = _score_planes(
+            capacity, used0, ask, elig, jc0, dt, pen, aff, has_aff, dh,
+            caps, algorithm_spread, max_j,
+        )
+        s_raw = jnp.where(fits, num / den, -jnp.inf)
+        # Selection runs on the running-min clamp: it restores the prefix
+        # rule "(n,j) requires (n,j-1)" that plain top-k needs.
+        s_sel = jax.lax.associative_scan(jnp.minimum, s_raw, axis=1)
+
+        flat_sel = s_sel.reshape(-1)  # [N*J]
+        flat_raw = s_raw.reshape(-1)
+        k_eff = min(k, flat_sel.shape[0])  # tiny clusters: < k slots total
+        top_sel, top_idx = jax.lax.top_k(flat_sel, k_eff)
+        if k_eff < k:
+            pad = k - k_eff
+            top_sel = jnp.concatenate(
+                [top_sel, jnp.full(pad, -jnp.inf, top_sel.dtype)]
+            )
+            top_idx = jnp.concatenate([top_idx, jnp.zeros(pad, top_idx.dtype)])
+        # report the TRUE (unclamped) score of each chosen (n, j) — the
+        # AllocMetric the oracle would have recorded for that placement
+        top_raw = flat_raw[top_idx]
+        node_rows = (top_idx // max_j).astype(jnp.int32)
+        ok = top_sel > -jnp.inf  # caller slices [:count] vs overflow
+        return jnp.where(ok, node_rows, -1), jnp.where(ok, top_raw, -jnp.inf)
+
+    return jax.vmap(one_group)(
+        asks, eligible, job_counts, desired_totals, penalty_nodes,
+        affinity_scores, has_affinities, distinct_hosts, slot_caps, counts,
+    )
+
+
+# -- gather-scan (spread / distinct_property groups) -------------------------
+
+
+def _block_tables(c, desired, caps, weights, kinds):
+    """Per-(block, value) boost + allowance tables from the current count
+    state ``c`` [B, V].
+
+    Target mode (spread.go:110-174): boost[v] = (desired − (c+1))/desired
+    × weight, where weight is already weight/Σweights; desired < 0 marks a
+    value with no explicit or implicit target → flat −1 (unweighted,
+    spread.go:145-152).
+
+    Even mode (spread.go:178-228 evenSpreadScoreBoost): boosts derive
+    from the min/max of *positive* counts. (The reference computes min
+    over a Go map that may contain cleared-to-zero entries, making the
+    min==0 branch order-dependent; we define min over positive counts,
+    which matches the deterministic reading.)
+
+    Distinct caps (feasible.go:604): allow[v] = c[v] < cap[v].
+    """
+    # target
+    t_boost = jnp.where(
+        desired > 0,
+        (desired - (c + 1.0)) / jnp.maximum(desired, 1e-9) * weights[:, None],
+        -1.0,
+    )
+    # even
+    pos = c > 0
+    any_pos = jnp.any(pos, axis=1, keepdims=True)  # [B, 1]
+    minc = jnp.min(jnp.where(pos, c, jnp.inf), axis=1, keepdims=True)
+    maxc = jnp.max(jnp.where(pos, c, -jnp.inf), axis=1, keepdims=True)
+    at_min = c == minc
+    e_boost = jnp.where(
+        at_min,
+        jnp.where(minc == maxc, -1.0, (maxc - minc) / jnp.maximum(minc, 1e-9)),
+        (minc - c) / jnp.maximum(minc, 1e-9),
+    )
+    e_boost = jnp.where(any_pos, e_boost, 0.0)
+
+    boost = jnp.where(
+        (kinds == BLOCK_TARGET_SPREAD)[:, None],
+        t_boost,
+        jnp.where((kinds == BLOCK_EVEN_SPREAD)[:, None], e_boost, 0.0),
+    )
+    allow = jnp.where((kinds == BLOCK_DISTINCT_CAP)[:, None], c < caps, True)
+    return boost, allow
+
+
+@functools.partial(jax.jit, static_argnames=("max_j", "max_steps"))
+def place_value_scan_kernel(
+    capacity,  # f32[N, D] shared
+    used0,  # f32[N, D] shared snapshot usage
+    asks,  # f32[G, D]
+    eligible,  # bool[G, N]
+    job_counts,  # i32[G, N]
+    desired_totals,  # f32[G]
+    penalty_nodes,  # bool[G, N]
+    affinity_scores,  # f32[G, N]
+    has_affinities,  # bool[G]
+    distinct_hosts,  # bool[G]
+    slot_caps,  # f32[G, N]
+    block_value_ids,  # i32[G, B, N] (−1 = node has no value)
+    block_counts0,  # f32[G, B, V]
+    block_desired,  # f32[G, B, V]
+    block_caps,  # f32[G, B, V]
+    block_weights,  # f32[G, B]
+    block_kinds,  # i32[G, B]
+    algorithm_spread,  # bool[]
+    counts,  # i32[G] placements to emit (incl. overflow slots)
+    max_j: int,
     max_steps: int,
 ):
-    """vmap of the greedy scan over the group/eval batch dimension.
+    """Greedy sequential placement with per-value count coupling.
 
-    Every group scores against the same snapshot ``used0`` — optimistic
-    concurrency identical to the reference's parallel workers
-    (doc scheduling.mdx:71-82); the plan applier re-checks fits and
-    partially rejects on conflict (nomad/plan_apply.go:439-596).
+    All heavy scoring is hoisted into the parallel [N, J] plane
+    precompute; each scan step gathers per-node column heads, adds the
+    per-value boost/allowance tables, and argmaxes — the device-resident
+    analog of re-running SpreadIterator + DistinctPropertyIterator per
+    placement (scheduler/spread.go:110, feasible.go:645), at O(N) gather
+    cost per step instead of O(N·D·stages) rescoring.
     """
-    return jax.vmap(
-        lambda a, e, jc, dt, pn, af, ha, svi, sd, sc, sw, hs, dh, sl, c: _place_scan(
-            capacity,
-            used0,
-            a,
-            e,
-            jc,
-            dt,
-            pn,
-            af,
-            ha,
-            svi,
-            sd,
-            sc,
-            sw,
-            hs,
-            dh,
-            sl,
-            algorithm_spread,
-            c,
-            max_steps,
+
+    def one_group(
+        ask, elig, jc0, dt, pen, aff, has_aff, dh, caps,
+        vids, c0, desired, vcaps, weights, kinds, count,
+    ):
+        num, den, fits = _score_planes(
+            capacity, used0, ask, elig, jc0, dt, pen, aff, has_aff, dh,
+            caps, algorithm_spread, max_j,
         )
-    )(
-        asks,
-        eligible,
-        job_counts,
-        desired_totals,
-        penalty_nodes,
-        affinity_scores,
-        has_affinities,
-        spread_value_ids,
-        spread_desired,
-        spread_counts,
-        spread_weights,
-        has_spreads,
-        distinct_hosts,
-        slot_caps,
-        counts,
+        n = num.shape[0]
+        is_spread = (kinds == BLOCK_TARGET_SPREAD) | (kinds == BLOCK_EVEN_SPREAD)
+        has_spread_any = jnp.any(is_spread)
+        safe_vids = jnp.maximum(vids, 0)  # [B, N]
+
+        def step(state, i):
+            jn, c = state  # jn i32[N] next column per node; c f32[B, V]
+            head_j = jnp.minimum(jn, max_j - 1)
+            gather = lambda plane: jnp.take_along_axis(
+                plane, head_j[:, None], axis=1
+            )[:, 0]
+            head_num = gather(num)
+            head_den = gather(den)
+            head_fit = gather(fits) & (jn < max_j)
+
+            tbl, allow = _block_tables(c, desired, vcaps, weights, kinds)
+            per_block = jnp.take_along_axis(tbl, safe_vids, axis=1)  # [B, N]
+            contrib = jnp.where(vids >= 0, per_block, -1.0)
+            boost = jnp.sum(
+                jnp.where(is_spread[:, None], contrib, 0.0), axis=0
+            )  # [N]
+            allow_pb = jnp.take_along_axis(allow, safe_vids, axis=1)
+            allowed = jnp.all(
+                jnp.where(
+                    (kinds == BLOCK_DISTINCT_CAP)[:, None] & (vids >= 0),
+                    allow_pb,
+                    True,
+                ),
+                axis=0,
+            )  # [N]
+
+            spread_on = has_spread_any & (boost != 0.0)
+            den_t = head_den + jnp.where(spread_on, 1.0, 0.0)
+            score = (head_num + jnp.where(spread_on, boost, 0.0)) / den_t
+            score = jnp.where(head_fit & allowed, score, -jnp.inf)
+
+            best = jnp.argmax(score)
+            ok = (score[best] > -jnp.inf) & (i < count)
+            onehot = (jnp.arange(n) == best) & ok
+            jn = jn + onehot.astype(jn.dtype)
+            bumped = vids[:, best]  # [B] value per block at the chosen node
+            c = c + jnp.where(
+                (ok & (bumped >= 0))[:, None],
+                jax.nn.one_hot(
+                    jnp.maximum(bumped, 0), c.shape[1], dtype=c.dtype
+                ),
+                0.0,
+            )
+            return (jn, c), (
+                jnp.where(ok, best, -1).astype(jnp.int32),
+                jnp.where(ok, score[best], -jnp.inf).astype(jnp.float32),
+            )
+
+        state0 = (jnp.zeros(n, dtype=jnp.int32), c0)
+        _, (choices, scores) = jax.lax.scan(
+            step, state0, jnp.arange(max_steps)
+        )
+        return choices, scores
+
+    return jax.vmap(one_group)(
+        asks, eligible, job_counts, desired_totals, penalty_nodes,
+        affinity_scores, has_affinities, distinct_hosts, slot_caps,
+        block_value_ids, block_counts0, block_desired, block_caps,
+        block_weights, block_kinds, counts,
     )
 
 
@@ -280,7 +430,7 @@ def score_matrix_kernel(
     algorithm_spread,
 ):
     """The dense evals×nodes score matrix (no sequential state) — used for
-    dry-run annotation, top-k explainability, and benchmarks."""
+    dry-run annotation, the system scheduler, and benchmarks."""
     zero_boost = jnp.zeros(capacity.shape[0], dtype=jnp.float32)
 
     def one(a, e, jc, dt, pn, af, ha, dh):
@@ -309,131 +459,6 @@ def _steps_bucket(n: int) -> int:
     return b
 
 
-# -- closed-form greedy (the TPU-shaped fast path) ---------------------------
-#
-# For one group placing ``count`` IDENTICAL asks, each node's score as a
-# function of j (instances of this group already placed on it) is a closed
-# form: usage is used0 + j·ask, collisions are job_counts0 + j. With no
-# spread block (whose boost couples nodes through global per-value counts),
-# node scores are independent, and the per-node score sequence s[n, j] is
-# monotone non-increasing in j (binpack worsens with usage, anti-affinity
-# grows; the single non-monotone corner — a penalty term diluting the
-# normalization mean at the j=0→1 component-count change — is clamped by a
-# running min). Greedy placement then equals: take the ``count`` largest
-# entries of the [N, J] matrix under the prefix rule "(n, j) requires
-# (n, j-1)" — which monotone rows turn into a plain top-k over the
-# flattened matrix. One fully-parallel scoring pass + one top_k replaces
-# ``count`` sequential scan steps.
-#
-# This is the "batched dense score matrix" BASELINE.json names as the
-# north-star replacement for the reference's per-placement iterator walk
-# (scheduler/rank.go:193-527): O(N·J) parallel work, O(log) depth.
-
-
-@functools.partial(jax.jit, static_argnames=("max_j", "k"))
-def place_closed_form_kernel(
-    capacity,  # f32[N, D] shared
-    used0,  # f32[N, D] shared snapshot usage
-    asks,  # f32[G, D]
-    eligible,  # bool[G, N]
-    job_counts,  # i32[G, N]
-    desired_totals,  # f32[G]
-    penalty_nodes,  # bool[G, N]
-    affinity_scores,  # f32[G, N]
-    has_affinities,  # bool[G]
-    distinct_hosts,  # bool[G]
-    slot_caps,  # f32[G, N]
-    algorithm_spread,  # bool[]
-    counts,  # i32[G]
-    max_j: int,  # static: max instances of one group per node
-    k: int,  # static: top-k width (≥ max count in batch)
-):
-    """Returns (choices i32[G, k], scores f32[G, k]) — node row per
-    placement step in greedy order, −1 past count/capacity."""
-
-    js = jnp.arange(max_j, dtype=jnp.float32)  # [J]
-
-    def one_group(ask, elig, jc0, dt, pen, aff, has_aff, dh, caps, count):
-        # Work in [N, J] planes only — a [N, J, D] temp is N·J·D·4 bytes
-        # and OOMs at 40k-node scale; the D axis is tiny and static, so
-        # unroll it (proposed usage after the (j+1)-th instance is
-        # used0[:, d] + (j+1)·ask[d]).
-        mult = js[None, :] + 1.0  # [1, J]
-        fits = elig[:, None] & jnp.ones((1, js.shape[0]), dtype=bool)
-        for d in range(capacity.shape[1]):
-            prop_d = used0[:, d:d + 1] + mult * ask[d]
-            fits &= prop_d <= capacity[:, d:d + 1]
-        # distinct_hosts ⇒ only j=0 and only where no existing collision
-        dh_mask = jnp.where(dh, (js[None, :] == 0) & (jc0[:, None] == 0), True)
-        fits &= dh_mask
-        fits &= js[None, :] < caps[:, None]  # device-slot caps
-
-        pow_sum = jnp.zeros_like(fits, dtype=jnp.float32)
-        for d in (0, 1):  # cpu, mem drive the fit score
-            cap_d = capacity[:, d:d + 1]
-            prop_d = used0[:, d:d + 1] + mult * ask[d]
-            free_d = jnp.where(
-                cap_d > 0, (cap_d - prop_d) / jnp.maximum(cap_d, 1e-9), 1.0
-            )
-            pow_sum = pow_sum + _pow10(free_d)
-        binpack = jnp.clip(20.0 - pow_sum, 0.0, BINPACK_MAX_SCORE)
-        spread_fit = jnp.clip(pow_sum - 2.0, 0.0, BINPACK_MAX_SCORE)
-        fit_score = (
-            jnp.where(algorithm_spread, spread_fit, binpack) / BINPACK_MAX_SCORE
-        )
-
-        coll = jc0[:, None].astype(jnp.float32) + js[None, :]  # after j placed
-        has_coll = coll > 0
-        anti = jnp.where(
-            has_coll, -(coll + 1.0) / jnp.maximum(dt, 1.0), 0.0
-        )
-        resched = jnp.where(pen[:, None], -1.0, 0.0)
-        aff_c = jnp.where(has_aff, aff[:, None], 0.0)
-        n_comp = (
-            1.0
-            + has_coll
-            + pen[:, None]
-            + jnp.where(has_aff, 1.0, 0.0)
-        )
-        s_raw = (fit_score + anti + resched + aff_c) / n_comp  # [N, J]
-        s_raw = jnp.where(fits, s_raw, -jnp.inf)
-        # Selection runs on the running-min clamp: it restores the prefix
-        # rule "(n,j) requires (n,j-1)" that plain top-k needs. Binpack is
-        # best-fit, so per-node sequences can RISE as a node fills; the
-        # clamp flattens a rising run to its initial head — top-k then
-        # fills nodes in descending initial-score order, which is exactly
-        # what stepwise greedy does with rising heads (a rising head stays
-        # max until the node is exhausted).
-        s_sel = jax.lax.associative_scan(jnp.minimum, s_raw, axis=1)
-
-        flat_sel = s_sel.reshape(-1)  # [N*J]
-        flat_raw = s_raw.reshape(-1)
-        k_eff = min(k, flat_sel.shape[0])  # tiny clusters: < k slots total
-        top_sel, top_idx = jax.lax.top_k(flat_sel, k_eff)
-        if k_eff < k:
-            pad = k - k_eff
-            top_sel = jnp.concatenate(
-                [top_sel, jnp.full(pad, -jnp.inf, top_sel.dtype)]
-            )
-            top_idx = jnp.concatenate(
-                [top_idx, jnp.zeros(pad, top_idx.dtype)]
-            )
-        # report the TRUE (unclamped) score of each chosen (n, j) — the
-        # AllocMetric the oracle would have recorded for that placement
-        top_raw = flat_raw[top_idx]
-        node_rows = (top_idx // max_j).astype(jnp.int32)
-        step = jnp.arange(k)
-        ok = (top_sel > -jnp.inf) & (step < count)
-        return jnp.where(ok, node_rows, -1), jnp.where(
-            ok, top_raw, -jnp.inf
-        )
-
-    return jax.vmap(one_group)(
-        asks, eligible, job_counts, desired_totals, penalty_nodes,
-        affinity_scores, has_affinities, distinct_hosts, slot_caps, counts,
-    )
-
-
 def _dummy_ask(pn: int):
     """Zero-count padding lane for the group axis: eligible nowhere, so
     the kernel places nothing and its lane is dropped on unpack. Keeps
@@ -453,12 +478,6 @@ def _dummy_ask(pn: int):
         affinity_scores=np.zeros(pn, dtype=np.float32),
         has_affinities=False,
         distinct_hosts=False,
-        spread_value_ids=np.full(pn, -1, dtype=np.int32),
-        spread_desired=np.zeros(1, dtype=np.float32),
-        spread_initial_counts=np.zeros(1, dtype=np.float32),
-        spread_weight=0.0,
-        has_spreads=False,
-        num_spread_values=1,
     )
 
 
@@ -477,7 +496,7 @@ def _pad_group_axis(asks: list, pn: int) -> list:
 
 def _shared_batch(asks: list, pn: int) -> dict:
     """Host-side assembly of the kernel inputs common to both placement
-    paths (the spread-only fields are added by the scan path)."""
+    paths (the value-block fields are added by the scan path)."""
     return dict(
         asks=np.stack([a.ask for a in asks]),
         eligible=np.stack([a.eligible for a in asks]),
@@ -504,10 +523,17 @@ def _shared_batch(asks: list, pn: int) -> dict:
 @dataclass
 class PlacementResult:
     """Host-side result for one group: chosen node rows (−1 = failed) and
-    their normalized scores, in placement order."""
+    their normalized scores, in placement order; plus overflow candidates
+    (the next entries greedy would have taken) for conflict repair."""
 
     node_rows: np.ndarray
     scores: np.ndarray
+    overflow_rows: np.ndarray = field(
+        default_factory=lambda: np.empty(0, dtype=np.int32)
+    )
+    overflow_scores: np.ndarray = field(
+        default_factory=lambda: np.empty(0, dtype=np.float32)
+    )
 
 
 class PlacementKernel:
@@ -522,12 +548,13 @@ class PlacementKernel:
     def place(self, cluster, asks: list) -> list[PlacementResult]:
         if not asks:
             return []
-        # split: spread-free groups take the closed-form top-k fast path
-        # (node scores decouple); spread blocks couple nodes through global
-        # per-value counts and keep the sequential scan
+        # split: uncoupled groups take the closed-form top-k fast path;
+        # spread blocks / distinct_property caps couple nodes through
+        # global per-value counts and take the gather-scan
         fast, slow = [], []
         for i, a in enumerate(asks):
-            (slow if (a.has_spreads or self.force_scan) else fast).append(i)
+            coupled = a.blocks is not None and a.blocks.num_blocks > 0
+            (slow if (coupled or self.force_scan) else fast).append(i)
         out: list[Optional[PlacementResult]] = [None] * len(asks)
         if fast:
             for i, r in zip(fast, self._place_closed_form(
@@ -541,11 +568,9 @@ class PlacementKernel:
                 out[i] = r
         return out
 
-    def _place_closed_form(self, cluster, asks: list) -> list[PlacementResult]:
-        pn = cluster.padded_n
-        max_count = max(a.count for a in asks)
-        k = _steps_bucket(max(max_count, 1))
-        # J bound: most instances of one identical ask any node could hold
+    def _max_j(self, cluster, asks: list) -> int:
+        """J bound: most instances of one identical ask any node could
+        hold, bucketed to multiples of 16."""
         cap_max = np.asarray(cluster.capacity).max(axis=0)  # [D]
         max_j = 1
         for a in asks:
@@ -555,7 +580,13 @@ class PlacementKernel:
             else:
                 j = a.count
             max_j = max(max_j, min(j, a.count))
-        max_j = max(16, -(-max_j // 16) * 16)  # multiple-of-16 bucket
+        return max(16, -(-max_j // 16) * 16)
+
+    def _place_closed_form(self, cluster, asks: list) -> list[PlacementResult]:
+        pn = cluster.padded_n
+        max_count = max(a.count for a in asks)
+        k = _steps_bucket(max(max_count + OVERFLOW_CANDIDATES, 1))
+        max_j = self._max_j(cluster, asks)
 
         # chunk the group axis so the [chunk, N, J] planes stay within an
         # HBM budget (~2 GB of live f32 planes)
@@ -580,54 +611,139 @@ class PlacementKernel:
             max_j=max_j,
             k=k,
         )
-        choices = np.asarray(choices)
-        scores = np.asarray(scores)
+        choices = np.array(choices)  # writable copy: repair mutates rows
+        scores = np.array(scores)
         return [
             PlacementResult(
-                node_rows=choices[gi, : a.count], scores=scores[gi, : a.count]
+                node_rows=choices[gi, : a.count],
+                scores=scores[gi, : a.count],
+                overflow_rows=choices[gi, a.count :],
+                overflow_scores=scores[gi, a.count :],
             )
             for gi, a in enumerate(asks[:real_n])
         ]
 
     def _place_scan_batch(self, cluster, asks: list) -> list[PlacementResult]:
+        from .flatten import pad_value_blocks
+
         pn = cluster.padded_n
         real_n = len(asks)
         asks = _pad_group_axis(asks, pn)
         max_count = max(a.count for a in asks)
-        max_steps = _steps_bucket(max(max_count, 1))
-        max_v = _steps_bucket(max(a.num_spread_values for a in asks))
-
-        def pad_v(arr, fill=0.0):
-            out = np.full(max_v, fill, dtype=np.float32)
-            out[: arr.shape[0]] = arr
-            return out
+        max_steps = _steps_bucket(max(max_count + OVERFLOW_CANDIDATES, 1))
+        max_j = self._max_j(cluster, asks)
 
         batch = _shared_batch(asks, pn)
-        batch.update(
-            spread_value_ids=np.stack([a.spread_value_ids for a in asks]),
-            spread_desired=np.stack([pad_v(a.spread_desired) for a in asks]),
-            spread_counts=np.stack(
-                [pad_v(a.spread_initial_counts) for a in asks]
-            ),
-            spread_weights=np.array(
-                [a.spread_weight for a in asks], dtype=np.float32
-            ),
-            has_spreads=np.array([a.has_spreads for a in asks]),
-        )
-        choices, scores, _used = place_batch_kernel(
+        # emit overflow candidates past each lane's primary count
+        batch["counts"] = np.minimum(
+            batch["counts"] + OVERFLOW_CANDIDATES, max_steps
+        ).astype(np.int32)
+        # zero-count padding lanes stay inert (eligible nowhere)
+        batch["counts"] = np.where(
+            np.array([a.count for a in asks]) > 0, batch["counts"], 0
+        ).astype(np.int32)
+        batch.update(pad_value_blocks([a.blocks for a in asks], pn))
+        choices, scores = place_value_scan_kernel(
             jnp.asarray(cluster.capacity),
             jnp.asarray(cluster.used),
             **{k: jnp.asarray(v) for k, v in batch.items()},
             algorithm_spread=jnp.asarray(self.algorithm_spread),
+            max_j=max_j,
             max_steps=max_steps,
         )
-        choices = np.asarray(choices)
-        scores = np.asarray(scores)
+        choices = np.array(choices)  # writable copy: repair mutates rows
+        scores = np.array(scores)
         out = []
         for gi, a in enumerate(asks[:real_n]):
-            # scan emits [steps, ...] per lane → transpose handled by vmap:
-            # choices has shape [G, steps]
-            ch = choices[gi, : a.count]
-            sc = scores[gi, : a.count]
-            out.append(PlacementResult(node_rows=ch, scores=sc))
+            out.append(
+                PlacementResult(
+                    node_rows=choices[gi, : a.count],
+                    scores=scores[gi, : a.count],
+                    overflow_rows=choices[
+                        gi, a.count : a.count + OVERFLOW_CANDIDATES
+                    ],
+                    overflow_scores=scores[
+                        gi, a.count : a.count + OVERFLOW_CANDIDATES
+                    ],
+                )
+            )
         return out
+
+
+def repair_batch_conflicts(cluster, asks: list, results: list) -> list[bool]:
+    """Host-side optimistic-conflict resolution for one batched pass.
+
+    Every lane scored against the same snapshot ``used0``, so lanes can
+    pile onto the same best nodes (true argmax removes the decorrelation
+    the reference gets from per-worker shuffle sampling, stack.go:74-90).
+    Rather than letting the plan applier partially reject and re-running
+    whole evals, walk the lanes in order with a usage overlay: placements
+    that no longer fit are moved to the lane's next overflow candidate
+    that does. The plan applier's per-node AllocsFit re-check
+    (plan_apply.go:638-689) remains the authority.
+
+    Mutates each PlacementResult in place. Returns per-lane ``ok`` —
+    False when a conflicted placement had no usable overflow candidate
+    (caller should fall back to the individual path for that eval).
+    """
+    capacity = np.asarray(cluster.capacity)
+    used = np.asarray(cluster.used).copy()
+    ok_lanes: list[bool] = []
+    for a, res in zip(asks, results):
+        ok = True
+        taken_rows = set()  # rows this lane committed (distinct_hosts)
+        # per-(block, value) counts for distinct_property caps
+        blocks = a.blocks
+        counts = blocks.counts0.copy() if blocks is not None else None
+        overflow = list(
+            zip(res.overflow_rows.tolist(), res.overflow_scores.tolist())
+        )
+        of_idx = 0
+
+        def commit(row: int) -> None:
+            used[row] += a.ask
+            taken_rows.add(row)
+            if blocks is not None:
+                for b in range(blocks.num_blocks):
+                    v = blocks.value_ids[b, row]
+                    if v >= 0:
+                        counts[b, v] += 1
+
+        def acceptable(row: int) -> bool:
+            if row < 0:
+                return False
+            if not np.all(used[row] + a.ask <= capacity[row]):
+                return False
+            if a.distinct_hosts and row in taken_rows:
+                return False
+            if blocks is not None:
+                for b in range(blocks.num_blocks):
+                    if blocks.kinds[b] != BLOCK_DISTINCT_CAP:
+                        continue
+                    v = blocks.value_ids[b, row]
+                    if v >= 0 and counts[b, v] >= blocks.caps[b, v]:
+                        return False
+            return True
+
+        for i, row in enumerate(res.node_rows.tolist()):
+            if row < 0:
+                continue
+            if acceptable(row):
+                commit(row)
+                continue
+            # conflicted: advance through overflow candidates
+            repl = -1
+            while of_idx < len(overflow):
+                cand, sc = overflow[of_idx]
+                of_idx += 1
+                if acceptable(cand):
+                    repl = cand
+                    res.node_rows[i] = cand
+                    res.scores[i] = sc
+                    commit(cand)
+                    break
+            if repl < 0:
+                ok = False
+                break
+        ok_lanes.append(ok)
+    return ok_lanes

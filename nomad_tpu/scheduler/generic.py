@@ -362,6 +362,7 @@ class GenericScheduler:
                 len(prs),
                 nodes_sorted=nodes_sorted,
                 penalty_node_ids=penalty_nodes,
+                plan=self.plan,
             )
             tg_order.append((tg_name, prs, tg, ga))
         return ct, tg_order

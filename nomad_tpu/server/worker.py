@@ -157,10 +157,19 @@ class Worker:
                 all_asks.extend(asks)
 
         results = None
+        lane_ok: list[bool] = []
         if all_asks:
             try:
                 with metrics.timer("nomad.worker.invoke_scheduler"):
                     results = prepared[0][2].kernel.place(ct, all_asks)
+                # every lane scored against the same snapshot usage —
+                # true-argmax lanes pile onto the same best nodes, so
+                # resolve cross-lane overcommit host-side from each
+                # lane's overflow candidates instead of letting the
+                # applier partially reject whole evals
+                from ..device.score import repair_batch_conflicts
+
+                lane_ok = repair_batch_conflicts(ct, all_asks, results)
             except Exception:
                 # shared pass failed — every prepared eval falls back to
                 # the individual path rather than dying unacked
@@ -172,7 +181,13 @@ class Worker:
         off = 0
         for ev, token, sched, n in prepared:
             span = results[off : off + n]
+            span_ok = all(lane_ok[off : off + n])
             off += n
+            if not span_ok:
+                # a conflicted placement had no usable overflow candidate
+                metrics.incr("nomad.worker.batch_conflict_fallbacks")
+                singles.append((ev, token))
+                continue
             self._eval_token = token
             try:
                 if sched.complete_batch_attempt(span):

@@ -6,7 +6,29 @@ import numpy as np
 import pytest
 
 from nomad_tpu.device.score import PlacementKernel
-from nomad_tpu.device.flatten import ClusterTensors, GroupAsk, node_bucket
+from nomad_tpu.device.flatten import (
+    ClusterTensors,
+    GroupAsk,
+    ValueBlocks,
+    node_bucket,
+)
+from nomad_tpu.device.score import BLOCK_EVEN_SPREAD, BLOCK_TARGET_SPREAD
+
+
+def make_target_blocks(ct, nvals, desired_per_val, weight=1.0, counts0=None):
+    pn = ct.padded_n
+    vids = (np.arange(pn) % nvals).astype(np.int32)[None, :]
+    return ValueBlocks(
+        value_ids=vids,
+        counts0=(
+            counts0[None, :] if counts0 is not None
+            else np.zeros((1, nvals), dtype=np.float32)
+        ),
+        desired=np.full((1, nvals), desired_per_val, dtype=np.float32),
+        caps=np.full((1, nvals), np.inf, dtype=np.float32),
+        weights=np.array([weight], dtype=np.float32),
+        kinds=np.array([BLOCK_TARGET_SPREAD], dtype=np.int32),
+    )
 
 
 def make_cluster(n_nodes, seed=0, load_max=0.5):
@@ -56,10 +78,6 @@ def make_ask(ct, count, seed=0, job_counts=None, penalties=False,
         ),
         has_affinities=affinities,
         distinct_hosts=distinct_hosts,
-        spread_value_ids=np.full(pn, -1, dtype=np.int32),
-        spread_desired=np.zeros(1, dtype=np.float32),
-        spread_initial_counts=np.zeros(1, dtype=np.float32),
-        spread_weight=0.0, has_spreads=False, num_spread_values=1,
     )
 
 
@@ -145,12 +163,7 @@ def test_capacity_exhaustion_partial_placement():
 def test_spread_groups_fall_back_to_scan():
     ct = make_cluster(16, seed=4)
     a = make_ask(ct, count=6)
-    a.has_spreads = True
-    a.spread_value_ids = (np.arange(ct.padded_n) % 3).astype(np.int32)
-    a.spread_desired = np.full(3, 2.0, dtype=np.float32)
-    a.spread_initial_counts = np.zeros(3, dtype=np.float32)
-    a.spread_weight = 0.5
-    a.num_spread_values = 3
+    a.blocks = make_target_blocks(ct, nvals=3, desired_per_val=2.0)
     b = make_ask(ct, count=5, seed=11)
     fast_mixed = PlacementKernel("binpack").place(ct, [a, b])
     slow = PlacementKernel("binpack", force_scan=True).place(ct, [a, b])
@@ -165,12 +178,7 @@ def test_mixed_batch_preserves_order():
     for i in range(4):
         a = make_ask(ct, count=3, seed=20 + i)
         if i % 2:
-            a.has_spreads = True
-            a.spread_value_ids = (np.arange(ct.padded_n) % 2).astype(np.int32)
-            a.spread_desired = np.full(2, 2.0, dtype=np.float32)
-            a.spread_initial_counts = np.zeros(2, dtype=np.float32)
-            a.spread_weight = 0.3
-            a.num_spread_values = 2
+            a.blocks = make_target_blocks(ct, nvals=2, desired_per_val=2.0)
         asks.append(a)
     res = PlacementKernel("binpack").place(ct, asks)
     assert len(res) == 4 and all(r is not None for r in res)
