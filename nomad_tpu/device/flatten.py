@@ -305,7 +305,13 @@ def _eligibility_for_group(
         )
 
         escaped = True
-    if escaped or not constraints and not drivers:
+    if not constraints and not drivers and not volumes:
+        # nothing to check at all — skip the walk entirely. (Rare in real
+        # jobs: tasks always carry a driver, which routes through the
+        # cheap per-class branch below; this covers synthetic asks.)
+        rows = ()
+        per_class = False
+    elif escaped:
         rows = range(ct.num_nodes)
         per_class = False
     else:

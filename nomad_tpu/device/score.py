@@ -75,9 +75,39 @@ BLOCK_INACTIVE = -1
 # repair_batch_conflicts when optimistic batch lanes collide on a node
 OVERFLOW_CANDIDATES = 16
 
+# exact stepwise scan only for small groups; larger spread groups place in
+# chunks (boost tables frozen for CHUNK placements — spread counts move
+# slowly, and the host repair walk re-verifies every placement anyway)
+EXACT_SCAN_MAX_COUNT = 32
+CHUNK = 16
+
 
 def _pow10(x):
     return jnp.exp(_LN10 * x)
+
+
+def _unpack_mask(packed, n: int):
+    """Device-side unpack of a host np.packbits mask: u8[..., n/8] →
+    bool[..., n]. Per-lane masks dominate the host→device transfer for
+    big clusters (the axon tunnel moves ~35 MB/s; a dense [128, 16k]
+    bool batch alone is 2 MB), so bools ride packed 8×."""
+    bits = (
+        packed[..., :, None]
+        >> jnp.arange(7, -1, -1, dtype=packed.dtype)[None, :]
+    ) & 1
+    return bits.reshape(*packed.shape[:-1], -1)[..., :n].astype(bool)
+
+
+def _unpack_lane_inputs(capacity, eligible, job_counts, penalty_nodes):
+    """Normalize slim per-lane encodings at kernel entry (static on
+    dtype/shape at trace time): packed masks unpack to [G, N]; degenerate
+    [G, 1] arrays stay and broadcast through the score math."""
+    n = capacity.shape[0]
+    if eligible.dtype == jnp.uint8:
+        eligible = _unpack_mask(eligible, n)
+    if penalty_nodes.dtype == jnp.uint8:
+        penalty_nodes = _unpack_mask(penalty_nodes, n)
+    return eligible, job_counts.astype(jnp.int32), penalty_nodes
 
 
 def component_scores(
@@ -154,14 +184,26 @@ def _score_planes(
     40k-node scale; the D axis is tiny and static, so unroll it."""
     js = jnp.arange(max_j, dtype=jnp.float32)  # [J]
     mult = js[None, :] + 1.0  # [1, J]
-    fits = elig[:, None] & jnp.ones((1, max_j), dtype=bool)
-    for d in range(capacity.shape[1]):
-        prop_d = used0[:, d : d + 1] + mult * ask[d]
-        fits &= prop_d <= capacity[:, d : d + 1]
+    # Closed-form per-node feasible-column bound instead of D separate
+    # [N, J] comparison planes (the r3 regression suspect): used0 +
+    # (j+1)·ask ≤ cap for all dims ⇔ j < min_d floor((cap−used0)/ask).
+    # The 1e-6 nudge absorbs float division round-down on exact fits.
+    free0 = capacity - used0  # [N, D]
+    per_dim = jnp.where(
+        ask[None, :] > 0,
+        jnp.floor(free0 / jnp.maximum(ask[None, :], 1e-9) + 1e-6),
+        jnp.inf,
+    )
+    jmax = jnp.min(per_dim, axis=1)  # [N] feasible instances of this ask
+    jmax = jnp.where(elig, jmax, 0.0)
+    jmax = jnp.minimum(jmax, caps)  # device-slot caps
     # distinct_hosts ⇒ only j=0 and only where no existing collision
-    dh_mask = jnp.where(dh, (js[None, :] == 0) & (jc0[:, None] == 0), True)
-    fits &= dh_mask
-    fits &= js[None, :] < caps[:, None]  # device-slot caps
+    jmax = jnp.where(
+        dh,
+        jnp.where(jc0 == 0, jnp.minimum(jmax, 1.0), 0.0),
+        jmax,
+    )
+    fits = js[None, :] < jmax[:, None]  # [N, J]
 
     pow_sum = jnp.zeros_like(fits, dtype=jnp.float32)
     for d in (0, 1):  # cpu, mem drive the fit score
@@ -184,6 +226,10 @@ def _score_planes(
     aff_c = jnp.where(has_aff, aff[:, None], 0.0)
     num = fit_score + anti + resched + aff_c  # [N, J]
     den = 1.0 + has_coll + pen[:, None] + jnp.where(has_aff, 1.0, 0.0)
+    # slim [1]-shaped lane inputs leave den rank-deficient; the gather
+    # paths index it per node, so materialize the broadcast
+    num = jnp.broadcast_to(num, fits.shape)
+    den = jnp.broadcast_to(den, fits.shape)
     return num, den, fits
 
 
@@ -225,6 +271,10 @@ def place_closed_form_kernel(
     Entries past a lane's feasible candidates are −1/−inf; entries in
     [count, k) are valid *overflow* candidates for conflict repair."""
 
+    eligible, job_counts, penalty_nodes = _unpack_lane_inputs(
+        capacity, eligible, job_counts, penalty_nodes
+    )
+
     def one_group(ask, elig, jc0, dt, pen, aff, has_aff, dh, caps, count):
         num, den, fits = _score_planes(
             capacity, used0, ask, elig, jc0, dt, pen, aff, has_aff, dh,
@@ -252,9 +302,14 @@ def place_closed_form_kernel(
         ok = top_sel > -jnp.inf  # caller slices [:count] vs overflow
         return jnp.where(ok, node_rows, -1), jnp.where(ok, top_raw, -jnp.inf)
 
-    return jax.vmap(one_group)(
+    choices, scores = jax.vmap(one_group)(
         asks, eligible, job_counts, desired_totals, penalty_nodes,
         affinity_scores, has_affinities, distinct_hosts, slot_caps, counts,
+    )
+    # one fused [G, 2k] i32 result: the tunnel-attached TPU pays a full
+    # round trip per fetched array, so scores ride bitcast alongside rows
+    return jnp.concatenate(
+        [choices, jax.lax.bitcast_convert_type(scores, jnp.int32)], axis=1
     )
 
 
@@ -340,6 +395,10 @@ def place_value_scan_kernel(
     cost per step instead of O(N·D·stages) rescoring.
     """
 
+    eligible, job_counts, penalty_nodes = _unpack_lane_inputs(
+        capacity, eligible, job_counts, penalty_nodes
+    )
+
     def one_group(
         ask, elig, jc0, dt, pen, aff, has_aff, dh, caps,
         vids, c0, desired, vcaps, weights, kinds, count,
@@ -412,6 +471,326 @@ def place_value_scan_kernel(
         affinity_scores, has_affinities, distinct_hosts, slot_caps,
         block_value_ids, block_counts0, block_desired, block_caps,
         block_weights, block_kinds, counts,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_j", "chunk", "n_chunks"))
+def place_spread_chunked_kernel(
+    capacity,  # f32[N, D] shared
+    used0,  # f32[N, D] shared snapshot usage
+    asks,  # f32[G, D]
+    eligible,  # bool[G, N]
+    job_counts,  # i32[G, N]
+    desired_totals,  # f32[G]
+    penalty_nodes,  # bool[G, N]
+    affinity_scores,  # f32[G, N]
+    has_affinities,  # bool[G]
+    distinct_hosts,  # bool[G]
+    slot_caps,  # f32[G, N]
+    block_value_ids,  # i32[G, B, N] (−1 = node has no value)
+    block_counts0,  # f32[G, B, V]
+    block_desired,  # f32[G, B, V]
+    block_caps,  # f32[G, B, V]
+    block_weights,  # f32[G, B]
+    block_kinds,  # i32[G, B]
+    algorithm_spread,  # bool[]
+    counts,  # i32[G] placements to emit (incl. overflow slots)
+    max_j: int,
+    chunk: int,
+    n_chunks: int,
+):
+    """Chunked greedy placement for large spread-coupled groups.
+
+    The exact gather-scan (place_value_scan_kernel) pays one sequential
+    ``lax.scan`` step per placement — 250-instance groups compile to
+    512-deep scans whose per-step work is a trivial gather+argmax, the
+    exact wrong shape for a TPU (the r3 e2e p99 of 11.6 s lives here).
+    This kernel instead freezes the per-value boost/allowance tables for
+    ``chunk`` placements at a time and selects each chunk with the same
+    running-min-clamp + top-k used by the closed-form path, so a
+    250-instance group runs ~16 wide parallel steps instead of 512
+    narrow ones. Spread counts move by at most ``chunk`` between table
+    refreshes; the resulting boost staleness is bounded and verified
+    against the stepwise oracle in tests (test_value_scan.py). Caps
+    (distinct_property) can overshoot within a chunk, so groups with cap
+    blocks stay on the exact scan — see PlacementKernel.place routing.
+
+    Reference seam: scheduler/spread.go:110-228 recomputes boosts per
+    placement; the reference tolerates far coarser approximation in the
+    other direction by score-sampling only ≥100 nodes (stack.go:165-174).
+    """
+
+    eligible, job_counts, penalty_nodes = _unpack_lane_inputs(
+        capacity, eligible, job_counts, penalty_nodes
+    )
+
+    def one_group(
+        ask, elig, jc0, dt, pen, aff, has_aff, dh, caps,
+        vids, c0, desired, vcaps, weights, kinds, count,
+    ):
+        num, den, fits = _score_planes(
+            capacity, used0, ask, elig, jc0, dt, pen, aff, has_aff, dh,
+            caps, algorithm_spread, max_j,
+        )
+        n = num.shape[0]
+        nb = vids.shape[0]
+        is_spread = (kinds == BLOCK_TARGET_SPREAD) | (kinds == BLOCK_EVEN_SPREAD)
+        has_spread_any = jnp.any(is_spread)
+        safe_vids = jnp.maximum(vids, 0)  # [B, N]
+        js_row = jnp.arange(max_j, dtype=jnp.int32)[None, :]  # [1, J]
+
+        def step(state, _):
+            jn, c, n_placed = state  # i32[N], f32[B, V], i32[]
+            tbl, allow = _block_tables(c, desired, vcaps, weights, kinds)
+            per_block = jnp.take_along_axis(tbl, safe_vids, axis=1)  # [B, N]
+            contrib = jnp.where(vids >= 0, per_block, -1.0)
+            boost = jnp.sum(
+                jnp.where(is_spread[:, None], contrib, 0.0), axis=0
+            )  # [N]
+            allow_pb = jnp.take_along_axis(allow, safe_vids, axis=1)
+            allowed = jnp.all(
+                jnp.where(
+                    (kinds == BLOCK_DISTINCT_CAP)[:, None] & (vids >= 0),
+                    allow_pb,
+                    True,
+                ),
+                axis=0,
+            )  # [N]
+
+            spread_on = has_spread_any & (boost != 0.0)  # [N]
+            den_t = den + jnp.where(spread_on, 1.0, 0.0)[:, None]
+            s_raw = (num + jnp.where(spread_on, boost, 0.0)[:, None]) / den_t
+            feas = fits & allowed[:, None] & (js_row >= jn[:, None])
+            # consumed columns (j < jn) must not poison the running-min
+            s_for_min = jnp.where(
+                js_row < jn[:, None],
+                jnp.inf,
+                jnp.where(feas, s_raw, -jnp.inf),
+            )
+            s_sel = jax.lax.associative_scan(jnp.minimum, s_for_min, axis=1)
+            s_sel = jnp.where(feas, s_sel, -jnp.inf)
+
+            vals, idx = jax.lax.top_k(s_sel.reshape(-1), chunk)
+            take = (jnp.arange(chunk) + n_placed < count) & (vals > -jnp.inf)
+            rows = (idx // max_j).astype(jnp.int32)
+            true_scores = s_raw.reshape(-1)[idx]
+
+            # dense masked updates — TPU scatters serialize
+            jn = jn + jnp.sum(
+                (jnp.arange(n)[None, :] == rows[:, None])
+                & take[:, None],
+                axis=0,
+            ).astype(jnp.int32)
+            picked_vals = vids[:, rows]  # [B, chunk]
+            upd = take[None, :] & (picked_vals >= 0)
+            c = c + jnp.sum(
+                jnp.where(
+                    upd[:, :, None],
+                    picked_vals[:, :, None]
+                    == jnp.arange(c.shape[1])[None, None, :],
+                    False,
+                ).astype(c.dtype),
+                axis=1,
+            )
+            n_placed = n_placed + jnp.sum(take.astype(jnp.int32))
+            return (jn, c, n_placed), (
+                jnp.where(take, rows, -1),
+                jnp.where(take, true_scores, -jnp.inf).astype(jnp.float32),
+            )
+
+        state0 = (
+            jnp.zeros(n, dtype=jnp.int32),
+            c0,
+            jnp.zeros((), dtype=jnp.int32),
+        )
+        _, (choices, scores) = jax.lax.scan(
+            step, state0, None, length=n_chunks
+        )
+        return choices.reshape(-1), scores.reshape(-1)
+
+    return jax.vmap(one_group)(
+        asks, eligible, job_counts, desired_totals, penalty_nodes,
+        affinity_scores, has_affinities, distinct_hosts, slot_caps,
+        block_value_ids, block_counts0, block_desired, block_caps,
+        block_weights, block_kinds, counts,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_j", "k_seg", "n_chunks"))
+def place_spread_opv_kernel(
+    capacity,  # f32[N, D] shared
+    used0,  # f32[N, D] shared snapshot usage
+    asks,  # f32[G, D]
+    eligible,  # bool[G, N]
+    job_counts,  # i32[G, N]
+    desired_totals,  # f32[G]
+    penalty_nodes,  # bool[G, N]
+    affinity_scores,  # f32[G, N]
+    has_affinities,  # bool[G]
+    distinct_hosts,  # bool[G]
+    slot_caps,  # f32[G, N]
+    block_value_ids,  # i32[G, B, N]
+    block_counts0,  # f32[G, B, V]
+    block_desired,  # f32[G, B, V]
+    block_caps,  # f32[G, B, V]
+    block_weights,  # f32[G, B]
+    block_kinds,  # i32[G, B]
+    enforce_idx,  # i32[G] block whose values are one-per-chunk
+    algorithm_spread,  # bool[]
+    counts,  # i32[G] placements to emit (incl. overflow slots)
+    max_j: int,
+    k_seg: int,  # picks per step = min(CHUNK, V+1)
+    n_chunks: int,
+):
+    """One-per-value chunked placement for even-mode spread groups.
+
+    Even-spread boosts (spread.go:178-228) jump discontinuously as a
+    value stops being the min — freezing the boost table for a plain
+    CHUNK-sized step dumps the whole chunk onto the currently-min values
+    and oscillates. But stepwise greedy under even-spread naturally
+    *rotates* values (placing on the min value usually removes it from
+    the min set), so restricting each step to at most ONE placement per
+    value of the dominant even block recovers stepwise-like behavior
+    while still placing up to min(CHUNK, V) instances per sequential
+    step: per-value segment-max of the head scores, then top-k over the
+    [V+1] segment maxima (the +1 segment holds value-less nodes).
+    Depth count/min(CHUNK, V) instead of count — for the BASELINE
+    config-3 shape (250 instances × 25 racks) that is 18 steps vs 512.
+    """
+
+    eligible, job_counts, penalty_nodes = _unpack_lane_inputs(
+        capacity, eligible, job_counts, penalty_nodes
+    )
+
+    def one_group(
+        ask, elig, jc0, dt, pen, aff, has_aff, dh, caps,
+        vids, c0, desired, vcaps, weights, kinds, eidx, count,
+    ):
+        num, den, fits = _score_planes(
+            capacity, used0, ask, elig, jc0, dt, pen, aff, has_aff, dh,
+            caps, algorithm_spread, max_j,
+        )
+        n = num.shape[0]
+        nb = vids.shape[0]
+        nv = c0.shape[1]
+        is_spread = (kinds == BLOCK_TARGET_SPREAD) | (kinds == BLOCK_EVEN_SPREAD)
+        has_spread_any = jnp.any(is_spread)
+        safe_vids = jnp.maximum(vids, 0)  # [B, N]
+        evids = jnp.take(vids, eidx, axis=0)  # [N] enforce-block values
+        seg = jnp.where(evids >= 0, evids, nv)  # [N]; nv = no-value segment
+
+        def node_scores(head_num, head_den, head_ok, c):
+            tbl, allow = _block_tables(c, desired, vcaps, weights, kinds)
+            per_block = jnp.take_along_axis(tbl, safe_vids, axis=1)
+            contrib = jnp.where(vids >= 0, per_block, -1.0)
+            boost = jnp.sum(
+                jnp.where(is_spread[:, None], contrib, 0.0), axis=0
+            )
+            allow_pb = jnp.take_along_axis(allow, safe_vids, axis=1)
+            allowed = jnp.all(
+                jnp.where(
+                    (kinds == BLOCK_DISTINCT_CAP)[:, None] & (vids >= 0),
+                    allow_pb,
+                    True,
+                ),
+                axis=0,
+            )
+            spread_on = has_spread_any & (boost != 0.0)
+            den_t = head_den + jnp.where(spread_on, 1.0, 0.0)
+            score = (head_num + jnp.where(spread_on, boost, 0.0)) / den_t
+            return jnp.where(head_ok & allowed, score, -jnp.inf)
+
+        def step(state, _):
+            jn, c, n_placed = state
+            head_j = jnp.minimum(jn, max_j - 1)
+            gather = lambda plane: jnp.take_along_axis(
+                plane, head_j[:, None], axis=1
+            )[:, 0]
+            head_num = gather(num)
+            head_den = gather(den)
+            head_fit = gather(fits) & (jn < max_j)
+
+            # Two-phase chunk: spread counts sit at symmetric states (all
+            # values even ⇒ every even-boost −1) at chunk boundaries, and
+            # under a negative frozen total the component-count divisor
+            # inverts within-value ordering — the whole chunk would
+            # re-pick already-filled nodes. One placement breaks the
+            # symmetry exactly as stepwise greedy experiences it, so:
+            # pick 1 with the frozen table, bump its value, re-derive the
+            # table, then pick the remaining k−1 one-per-value.
+            score0 = node_scores(head_num, head_den, head_fit, c)
+            first = jnp.argmax(score0).astype(jnp.int32)
+            ok0 = (score0[first] > -jnp.inf) & (n_placed < count)
+            v_first = seg[first]  # segment (nv = value-less)
+            first_vals = vids[:, first]  # [B]
+            c1 = c + jnp.where(
+                (ok0 & (first_vals >= 0))[:, None],
+                jax.nn.one_hot(
+                    jnp.maximum(first_vals, 0), nv, dtype=c.dtype
+                ),
+                0.0,
+            )
+
+            score1 = node_scores(head_num, head_den, head_fit, c1)
+            score1 = jnp.where(seg == v_first, -jnp.inf, score1)
+            # dense masked segment-max — TPU scatters serialize, masked
+            # compare+reduce rides the VPU ([V+1, N] is small)
+            seg_plane = seg[None, :] == jnp.arange(nv + 1)[:, None]
+            seg_max = jnp.max(
+                jnp.where(seg_plane, score1[None, :], -jnp.inf), axis=1
+            )
+            vals, vsel = jax.lax.top_k(seg_max, k_seg - 1)
+            take_r = (
+                jnp.arange(k_seg - 1) + n_placed + ok0.astype(jnp.int32)
+                < count
+            ) & (vals > -jnp.inf) & ok0
+            in_seg = seg[None, :] == vsel[:, None]  # [k_seg-1, N]
+            rows_r = jnp.argmax(
+                jnp.where(in_seg, score1[None, :], -jnp.inf), axis=1
+            ).astype(jnp.int32)
+
+            rows = jnp.concatenate([first[None], rows_r])
+            take = jnp.concatenate([ok0[None], take_r])
+            vals_all = jnp.concatenate([score0[first][None], vals])
+
+            jn = jn + jnp.sum(
+                (jnp.arange(n)[None, :] == rows[:, None])
+                & take[:, None],
+                axis=0,
+            ).astype(jnp.int32)
+            picked_vals = vids[:, rows_r]  # [B, k_seg-1]
+            upd = take_r[None, :] & (picked_vals >= 0)
+            c = c1 + jnp.sum(
+                jnp.where(
+                    upd[:, :, None],
+                    picked_vals[:, :, None]
+                    == jnp.arange(c.shape[1])[None, None, :],
+                    False,
+                ).astype(c.dtype),
+                axis=1,
+            )
+            # ok0 False ⇒ c1 == c and nothing was taken
+            n_placed = n_placed + jnp.sum(take.astype(jnp.int32))
+            return (jn, c, n_placed), (
+                jnp.where(take, rows, -1),
+                jnp.where(take, vals_all, -jnp.inf).astype(jnp.float32),
+            )
+
+        state0 = (
+            jnp.zeros(n, dtype=jnp.int32),
+            c0,
+            jnp.zeros((), dtype=jnp.int32),
+        )
+        _, (choices, scores) = jax.lax.scan(
+            step, state0, None, length=n_chunks
+        )
+        return choices.reshape(-1), scores.reshape(-1)
+
+    return jax.vmap(one_group)(
+        asks, eligible, job_counts, desired_totals, penalty_nodes,
+        affinity_scores, has_affinities, distinct_hosts, slot_caps,
+        block_value_ids, block_counts0, block_desired, block_caps,
+        block_weights, block_kinds, enforce_idx, counts,
     )
 
 
@@ -495,27 +874,54 @@ def _pad_group_axis(asks: list, pn: int) -> list:
 
 
 def _shared_batch(asks: list, pn: int) -> dict:
-    """Host-side assembly of the kernel inputs common to both placement
-    paths (the value-block fields are added by the scan path)."""
-    return dict(
-        asks=np.stack([a.ask for a in asks]),
-        eligible=np.stack([a.eligible for a in asks]),
-        job_counts=np.stack([a.job_counts for a in asks]),
-        desired_totals=np.array(
-            [a.desired_total for a in asks], dtype=np.float32
-        ),
-        penalty_nodes=np.stack([a.penalty_nodes for a in asks]),
-        affinity_scores=np.stack([a.affinity_scores for a in asks]),
-        has_affinities=np.array([a.has_affinities for a in asks]),
-        distinct_hosts=np.array([a.distinct_hosts for a in asks]),
-        slot_caps=np.stack(
+    """Host-side assembly of the kernel inputs common to all placement
+    paths (the value-block fields are added by the coupled paths).
+
+    Transfer-slimmed for the tunnel-attached TPU (uploads were 3× the
+    kernel's own runtime at 10k nodes): eligibility/penalty masks ride
+    bit-packed (u8, 8×), and per-lane arrays that are degenerate across
+    the whole batch (no job allocs yet, no penalties, no affinities, no
+    device asks — the common case for fresh registrations) collapse to
+    [G, 1] broadcasts instead of [G, N] uploads."""
+    g = len(asks)
+    jc = np.stack([a.job_counts for a in asks])
+    if not jc.any():
+        jc = np.zeros((g, 1), dtype=np.int32)
+    pen = np.stack([a.penalty_nodes for a in asks])
+    pen = (
+        np.packbits(pen, axis=1)
+        if pen.any()
+        else np.zeros((g, 1), dtype=bool)
+    )
+    if any(a.has_affinities for a in asks):
+        aff = np.stack([a.affinity_scores for a in asks])
+    else:
+        aff = np.zeros((g, 1), dtype=np.float32)
+    if any(a.slot_caps is not None for a in asks):
+        caps = np.stack(
             [
                 a.slot_caps
                 if a.slot_caps is not None
                 else np.full(pn, np.inf, dtype=np.float32)
                 for a in asks
             ]
+        )
+    else:
+        caps = np.full((g, 1), np.inf, dtype=np.float32)
+    return dict(
+        asks=np.stack([a.ask for a in asks]),
+        eligible=np.packbits(
+            np.stack([a.eligible for a in asks]), axis=1
         ),
+        job_counts=jc,
+        desired_totals=np.array(
+            [a.desired_total for a in asks], dtype=np.float32
+        ),
+        penalty_nodes=pen,
+        affinity_scores=aff,
+        has_affinities=np.array([a.has_affinities for a in asks]),
+        distinct_hosts=np.array([a.distinct_hosts for a in asks]),
+        slot_caps=caps,
         counts=np.array([a.count for a in asks], dtype=np.int32),
     )
 
@@ -545,28 +951,61 @@ class PlacementKernel:
         self.algorithm_spread = algorithm == "spread"
         self.force_scan = force_scan  # parity testing: disable the fast path
 
-    def place(self, cluster, asks: list) -> list[PlacementResult]:
+    def place(
+        self,
+        cluster,
+        asks: list,
+        *,
+        overflow: int = OVERFLOW_CANDIDATES,
+        decorrelate: bool = False,
+    ) -> list[PlacementResult]:
+        """``overflow`` = extra greedy candidates emitted per lane for
+        conflict repair. ``decorrelate``: stripe each lane onto a disjoint
+        node partition so concurrent-eval lanes stop argmaxing onto the
+        same nodes — the vector analog of the reference's per-worker
+        shuffle sampling (stack.go:74-90); repair re-scores any shortfall
+        against the full node set, so partitioning is purely an
+        optimization."""
         if not asks:
             return []
-        # split: uncoupled groups take the closed-form top-k fast path;
-        # spread blocks / distinct_property caps couple nodes through
-        # global per-value counts and take the gather-scan
-        fast, slow = [], []
-        for i, a in enumerate(asks):
+        work = _decorrelate_lanes(cluster, asks) if decorrelate else asks
+        # routing: uncoupled groups → closed-form top-k; large
+        # spread-coupled groups → chunked (one-per-value variant when an
+        # even block is present); small / capped groups → exact scan
+        fast, chunked, opv, scan = [], [], [], []
+        for i, a in enumerate(work):
             coupled = a.blocks is not None and a.blocks.num_blocks > 0
-            (slow if (coupled or self.force_scan) else fast).append(i)
+            if self.force_scan or (coupled and self._needs_exact_scan(a)):
+                scan.append(i)
+            elif coupled:
+                if bool((a.blocks.kinds == BLOCK_EVEN_SPREAD).any()):
+                    opv.append(i)
+                else:
+                    chunked.append(i)
+            else:
+                fast.append(i)
         out: list[Optional[PlacementResult]] = [None] * len(asks)
-        if fast:
-            for i, r in zip(fast, self._place_closed_form(
-                cluster, [asks[i] for i in fast]
-            )):
-                out[i] = r
-        if slow:
-            for i, r in zip(slow, self._place_scan_batch(
-                cluster, [asks[i] for i in slow]
-            )):
-                out[i] = r
+        for idxs, fn in (
+            (fast, self._place_closed_form),
+            (chunked, self._place_spread_chunked),
+            (opv, self._place_spread_opv),
+            (scan, self._place_scan_batch),
+        ):
+            if idxs:
+                for i, r in zip(
+                    idxs, fn(cluster, [work[i] for i in idxs], overflow)
+                ):
+                    out[i] = r
         return out
+
+    @staticmethod
+    def _needs_exact_scan(a) -> bool:
+        """Cap (distinct_property) blocks can overshoot a per-value
+        budget within one chunk, and small groups compile to short exact
+        scans anyway — both stay on the stepwise path."""
+        if a.count <= EXACT_SCAN_MAX_COUNT:
+            return True
+        return bool((a.blocks.kinds == BLOCK_DISTINCT_CAP).any())
 
     def _max_j(self, cluster, asks: list) -> int:
         """J bound: most instances of one identical ask any node could
@@ -582,10 +1021,12 @@ class PlacementKernel:
             max_j = max(max_j, min(j, a.count))
         return max(16, -(-max_j // 16) * 16)
 
-    def _place_closed_form(self, cluster, asks: list) -> list[PlacementResult]:
+    def _place_closed_form(
+        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES
+    ) -> list[PlacementResult]:
         pn = cluster.padded_n
         max_count = max(a.count for a in asks)
-        k = _steps_bucket(max(max_count + OVERFLOW_CANDIDATES, 1))
+        k = _steps_bucket(max(max_count + overflow, 1))
         max_j = self._max_j(cluster, asks)
 
         # chunk the group axis so the [chunk, N, J] planes stay within an
@@ -596,23 +1037,27 @@ class PlacementKernel:
             out: list[PlacementResult] = []
             for i in range(0, len(asks), chunk):
                 out.extend(
-                    self._place_closed_form(cluster, asks[i:i + chunk])
+                    self._place_closed_form(
+                        cluster, asks[i:i + chunk], overflow
+                    )
                 )
             return out
 
         real_n = len(asks)
         asks = _pad_group_axis(asks, pn)
         batch = _shared_batch(asks, pn)
-        choices, scores = place_closed_form_kernel(
-            jnp.asarray(cluster.capacity),
-            jnp.asarray(cluster.used),
-            **{kk: jnp.asarray(v) for kk, v in batch.items()},
-            algorithm_spread=jnp.asarray(self.algorithm_spread),
-            max_j=max_j,
-            k=k,
+        fused = np.array(
+            place_closed_form_kernel(
+                jnp.asarray(cluster.capacity),
+                jnp.asarray(cluster.used),
+                **{kk: jnp.asarray(v) for kk, v in batch.items()},
+                algorithm_spread=jnp.asarray(self.algorithm_spread),
+                max_j=max_j,
+                k=k,
+            )
         )
-        choices = np.array(choices)  # writable copy: repair mutates rows
-        scores = np.array(scores)
+        choices = fused[:, :k]  # writable copies: repair mutates rows
+        scores = fused[:, k:].view(np.float32)
         return [
             PlacementResult(
                 node_rows=choices[gi, : a.count],
@@ -623,20 +1068,22 @@ class PlacementKernel:
             for gi, a in enumerate(asks[:real_n])
         ]
 
-    def _place_scan_batch(self, cluster, asks: list) -> list[PlacementResult]:
+    def _place_scan_batch(
+        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES
+    ) -> list[PlacementResult]:
         from .flatten import pad_value_blocks
 
         pn = cluster.padded_n
         real_n = len(asks)
         asks = _pad_group_axis(asks, pn)
         max_count = max(a.count for a in asks)
-        max_steps = _steps_bucket(max(max_count + OVERFLOW_CANDIDATES, 1))
+        max_steps = _steps_bucket(max(max_count + overflow, 1))
         max_j = self._max_j(cluster, asks)
 
         batch = _shared_batch(asks, pn)
         # emit overflow candidates past each lane's primary count
         batch["counts"] = np.minimum(
-            batch["counts"] + OVERFLOW_CANDIDATES, max_steps
+            batch["counts"] + overflow, max_steps
         ).astype(np.int32)
         # zero-count padding lanes stay inert (eligible nowhere)
         batch["counts"] = np.where(
@@ -651,58 +1098,311 @@ class PlacementKernel:
             max_j=max_j,
             max_steps=max_steps,
         )
-        choices = np.array(choices)  # writable copy: repair mutates rows
+        return self._unpack_coupled(choices, scores, asks[:real_n], overflow)
+
+    def _place_spread_chunked(
+        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES
+    ) -> list[PlacementResult]:
+        from .flatten import pad_value_blocks
+
+        pn = cluster.padded_n
+        real_n = len(asks)
+        asks = _pad_group_axis(asks, pn)
+        max_count = max(a.count for a in asks)
+        max_j = self._max_j(cluster, asks)
+        # round chunk count to a multiple of 4, not a power of two — the
+        # sequential depth is the dominant cost and 2× overshoot is real
+        # wall-clock; a handful of extra compile variants is not
+        n_chunks = max(4, -(-max(-(-(max_count + overflow) // CHUNK), 1) // 4) * 4)
+
+        batch = _shared_batch(asks, pn)
+        batch["counts"] = np.minimum(
+            batch["counts"] + overflow, n_chunks * CHUNK
+        ).astype(np.int32)
+        batch["counts"] = np.where(
+            np.array([a.count for a in asks]) > 0, batch["counts"], 0
+        ).astype(np.int32)
+        batch.update(pad_value_blocks([a.blocks for a in asks], pn))
+        choices, scores = place_spread_chunked_kernel(
+            jnp.asarray(cluster.capacity),
+            jnp.asarray(cluster.used),
+            **{k: jnp.asarray(v) for k, v in batch.items()},
+            algorithm_spread=jnp.asarray(self.algorithm_spread),
+            max_j=max_j,
+            chunk=CHUNK,
+            n_chunks=n_chunks,
+        )
+        return self._unpack_coupled(choices, scores, asks[:real_n], overflow)
+
+    def _place_spread_opv(
+        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES
+    ) -> list[PlacementResult]:
+        from .flatten import pad_value_blocks
+
+        pn = cluster.padded_n
+        real_n = len(asks)
+        asks = _pad_group_axis(asks, pn)
+        max_j = self._max_j(cluster, asks)
+
+        batch = _shared_batch(asks, pn)
+        blocks_list = [a.blocks for a in asks]
+        batch.update(pad_value_blocks(blocks_list, pn))
+        nv = batch["block_counts0"].shape[2]
+        k_seg = min(CHUNK, nv + 1)
+
+        # per-lane: dominant even block + how many picks one chunk can
+        # actually yield (active values of that block, +1 for value-less
+        # nodes) — lanes with few values need more sequential chunks
+        enforce_idx = np.zeros(len(asks), dtype=np.int32)
+        lane_steps = 1
+        for gi, a in enumerate(asks):
+            b = a.blocks
+            if b is None or a.count <= 0:
+                continue
+            even = np.flatnonzero(b.kinds == BLOCK_EVEN_SPREAD)
+            if even.size:
+                enforce_idx[gi] = even[np.argmax(b.weights[even])]
+            ev = b.value_ids[enforce_idx[gi]]
+            # a step can only yield picks from segments that hold at
+            # least one ELIGIBLE node (pad rows and unreachable values
+            # yield nothing — counting them under-provisions n_chunks
+            # and truncates the lane's placements)
+            elig = a.eligible
+            v_act = len(np.unique(ev[(ev >= 0) & elig])) + int(
+                ((ev < 0) & elig).any()
+            )
+            per_chunk = max(1, min(k_seg, v_act))
+            lane_steps = max(
+                lane_steps, -(-(a.count + overflow) // per_chunk)
+            )
+        # multiple-of-4 rounding, not power-of-two (sequential depth is
+        # the dominant cost; see _place_spread_chunked)
+        n_chunks = max(4, -(-lane_steps // 4) * 4)
+
+        batch["counts"] = np.minimum(
+            batch["counts"] + overflow, n_chunks * k_seg
+        ).astype(np.int32)
+        batch["counts"] = np.where(
+            np.array([a.count for a in asks]) > 0, batch["counts"], 0
+        ).astype(np.int32)
+        choices, scores = place_spread_opv_kernel(
+            jnp.asarray(cluster.capacity),
+            jnp.asarray(cluster.used),
+            **{k: jnp.asarray(v) for k, v in batch.items()},
+            enforce_idx=jnp.asarray(enforce_idx),
+            algorithm_spread=jnp.asarray(self.algorithm_spread),
+            max_j=max_j,
+            k_seg=k_seg,
+            n_chunks=n_chunks,
+        )
+        return self._unpack_coupled(choices, scores, asks[:real_n], overflow)
+
+    @staticmethod
+    def _unpack_coupled(choices, scores, asks, overflow):
+        """Compact each lane's valid picks (greedy emission order) into
+        count primary + overflow slots. The one-per-value kernel can
+        intersperse empty slots between chunks (a chunk is capped at one
+        pick per value, not by feasibility), so valid picks are compacted
+        rather than sliced positionally."""
+        choices = np.array(choices)
         scores = np.array(scores)
         out = []
-        for gi, a in enumerate(asks[:real_n]):
+        for gi, a in enumerate(asks):
+            row = choices[gi]
+            valid = row >= 0
+            vrows = row[valid]
+            vscores = scores[gi][valid]
+            node_rows = np.full(a.count, -1, dtype=np.int32)
+            sc = np.full(a.count, -np.inf, dtype=np.float32)
+            n_primary = min(a.count, vrows.shape[0])
+            node_rows[:n_primary] = vrows[:n_primary]
+            sc[:n_primary] = vscores[:n_primary]
+            of_rows = np.full(overflow, -1, dtype=np.int32)
+            of_sc = np.full(overflow, -np.inf, dtype=np.float32)
+            n_of = min(overflow, max(0, vrows.shape[0] - a.count))
+            of_rows[:n_of] = vrows[a.count : a.count + n_of]
+            of_sc[:n_of] = vscores[a.count : a.count + n_of]
             out.append(
                 PlacementResult(
-                    node_rows=choices[gi, : a.count],
-                    scores=scores[gi, : a.count],
-                    overflow_rows=choices[
-                        gi, a.count : a.count + OVERFLOW_CANDIDATES
-                    ],
-                    overflow_scores=scores[
-                        gi, a.count : a.count + OVERFLOW_CANDIDATES
-                    ],
+                    node_rows=node_rows,
+                    scores=sc,
+                    overflow_rows=of_rows,
+                    overflow_scores=of_sc,
                 )
             )
         return out
 
 
-def repair_batch_conflicts(cluster, asks: list, results: list) -> list[bool]:
+def _decorrelate_lanes(cluster, asks: list) -> list:
+    """Stripe each batch lane onto a disjoint subset of node rows
+    (row % n_lanes == lane). Concurrent lanes scoring the same snapshot
+    otherwise compute near-identical greedy sequences and pile onto the
+    same nodes — the r3 bench measured a 92.9% conflict-fallback rate.
+    The reference decorrelates its parallel workers by per-worker node
+    shuffling + limit sampling (stack.go:74-90); a 1/L stripe of a 10k
+    cluster still offers each lane more candidates than the reference's
+    ≥100-node sample. Lanes whose stripe leaves thin headroom (or whose
+    constraints concentrate eligibility) keep the full node set — repair
+    resolves whatever conflicts remain."""
+    from dataclasses import replace
+
+    n_lanes = len(asks)
+    if n_lanes < 2:
+        return asks
+    pn = cluster.padded_n
+    stripe_of = np.arange(pn) % n_lanes
+    out = []
+    for i, a in enumerate(asks):
+        if a.count <= 0:
+            out.append(a)
+            continue
+        elig = a.eligible & (stripe_of == i)
+        ok = int(elig.sum()) >= max(2 * a.count, 8)
+        if ok and a.blocks is not None:
+            # the stripe must not silently amputate spread/cap values:
+            # every value reachable from the full eligible set must stay
+            # reachable from the stripe (rack-contiguous row orderings
+            # with racks smaller than the lane count would otherwise skew
+            # the spread with no error surfaced)
+            for b in range(a.blocks.num_blocks):
+                vids = a.blocks.value_ids[b]
+                full_vals = np.unique(vids[(vids >= 0) & a.eligible])
+                stripe_vals = np.unique(vids[(vids >= 0) & elig])
+                if full_vals.shape[0] != stripe_vals.shape[0]:
+                    ok = False
+                    break
+        out.append(replace(a, eligible=elig) if ok else a)
+    return out
+
+
+def _host_block_tables(c, blocks):
+    """NumPy mirror of _block_tables for one lane's [B, V] count state."""
+    boost = np.zeros_like(c)
+    allow = np.ones_like(c, dtype=bool)
+    for b in range(blocks.num_blocks):
+        kind = blocks.kinds[b]
+        if kind == BLOCK_TARGET_SPREAD:
+            d = blocks.desired[b]
+            boost[b] = np.where(
+                d > 0,
+                (d - (c[b] + 1.0)) / np.maximum(d, 1e-9) * blocks.weights[b],
+                -1.0,
+            )
+        elif kind == BLOCK_EVEN_SPREAD:
+            pos = c[b] > 0
+            if pos.any():
+                minc = float(c[b][pos].min())
+                maxc = float(c[b][pos].max())
+                at_min = c[b] == minc
+                boost[b] = np.where(
+                    at_min,
+                    -1.0 if minc == maxc else (maxc - minc) / max(minc, 1e-9),
+                    (minc - c[b]) / max(minc, 1e-9),
+                )
+        elif kind == BLOCK_DISTINCT_CAP:
+            allow[b] = c[b] < blocks.caps[b]
+    return boost, allow
+
+
+def _rescore_pick(capacity, used, a, placed_on_node, counts, algorithm_spread):
+    """Exact host-side argmax for one additional placement of ``a``
+    against a usage overlay — the same component semantics as the device
+    kernels (see module docstring), in one vectorized NumPy pass. Used by
+    repair when a lane's precomputed overflow candidates run out, so a
+    conflicted placement is re-placed instead of aborting the whole eval.
+    Returns (row, score) with row −1 when nothing fits."""
+    prop = used + a.ask[None, :]
+    fits = np.all(prop <= capacity, axis=1) & a.eligible
+    jc = a.job_counts + placed_on_node
+    if a.distinct_hosts:
+        fits &= jc == 0
+    if a.slot_caps is not None:
+        fits &= placed_on_node < a.slot_caps
+    blocks = a.blocks
+    boost = np.zeros(capacity.shape[0], dtype=np.float32)
+    has_spread_any = False
+    if blocks is not None:
+        tbl_boost, tbl_allow = _host_block_tables(counts, blocks)
+        for b in range(blocks.num_blocks):
+            vids = blocks.value_ids[b]
+            safe = np.maximum(vids, 0)
+            if blocks.kinds[b] == BLOCK_DISTINCT_CAP:
+                fits &= np.where(vids >= 0, tbl_allow[b][safe], True)
+            elif blocks.kinds[b] in (BLOCK_TARGET_SPREAD, BLOCK_EVEN_SPREAD):
+                has_spread_any = True
+                boost += np.where(vids >= 0, tbl_boost[b][safe], -1.0)
+    if not fits.any():
+        return -1, -np.inf
+    free = np.where(
+        capacity > 0, (capacity - prop) / np.maximum(capacity, 1e-9), 1.0
+    )
+    pow_sum = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
+    binpack = np.clip(20.0 - pow_sum, 0.0, BINPACK_MAX_SCORE)
+    spread_fit = np.clip(pow_sum - 2.0, 0.0, BINPACK_MAX_SCORE)
+    fit_score = (spread_fit if algorithm_spread else binpack) / BINPACK_MAX_SCORE
+    coll = jc.astype(np.float32)
+    anti = np.where(jc > 0, -(coll + 1.0) / max(a.desired_total, 1.0), 0.0)
+    resched = np.where(a.penalty_nodes, -1.0, 0.0)
+    aff = a.affinity_scores if a.has_affinities else 0.0
+    spread_on = has_spread_any & (boost != 0.0)
+    num = fit_score + anti + resched + aff + np.where(spread_on, boost, 0.0)
+    den = (
+        1.0
+        + (jc > 0)
+        + a.penalty_nodes
+        + (1.0 if a.has_affinities else 0.0)
+        + spread_on
+    )
+    score = np.where(fits, num / den, -np.inf)
+    row = int(np.argmax(score))
+    return row, float(score[row])
+
+
+def repair_batch_conflicts(
+    cluster, asks: list, results: list, algorithm_spread: bool = False
+) -> list[bool]:
     """Host-side optimistic-conflict resolution for one batched pass.
 
     Every lane scored against the same snapshot ``used0``, so lanes can
     pile onto the same best nodes (true argmax removes the decorrelation
-    the reference gets from per-worker shuffle sampling, stack.go:74-90).
-    Rather than letting the plan applier partially reject and re-running
-    whole evals, walk the lanes in order with a usage overlay: placements
-    that no longer fit are moved to the lane's next overflow candidate
-    that does. The plan applier's per-node AllocsFit re-check
+    the reference gets from per-worker shuffle sampling, stack.go:74-90;
+    _decorrelate_lanes removes most of the correlation up front). Walk
+    the lanes in order with a usage overlay: placements that no longer
+    fit move to the lane's next overflow candidate, and when overflow
+    runs out an exact NumPy re-score places them directly — only the
+    *conflicted placement* is re-placed, never the whole eval. Kernel
+    failures (row −1, e.g. a lane whose stripe ran dry) get the same
+    re-score. The plan applier's per-node AllocsFit re-check
     (plan_apply.go:638-689) remains the authority.
 
     Mutates each PlacementResult in place. Returns per-lane ``ok`` —
-    False when a conflicted placement had no usable overflow candidate
-    (caller should fall back to the individual path for that eval).
+    False only when a placement is unplaceable under the batch overlay
+    but WOULD fit without the other lanes' placements (true cross-eval
+    contention): that eval should re-run individually against fresh
+    state, where preemption and retries apply. Intrinsically infeasible
+    placements (caps exhausted, cluster full even alone) stay −1 with
+    ok=True — they'd fail individually too, and become blocked evals.
     """
     capacity = np.asarray(cluster.capacity)
-    used = np.asarray(cluster.used).copy()
+    used0 = np.asarray(cluster.used)
+    used = used0.copy()
     ok_lanes: list[bool] = []
     for a, res in zip(asks, results):
         ok = True
-        taken_rows = set()  # rows this lane committed (distinct_hosts)
-        # per-(block, value) counts for distinct_property caps
+        # within-lane placements per node (distinct_hosts, slot caps,
+        # anti-affinity collisions all key off it)
+        placed_on_node: dict[int, int] = {}
         blocks = a.blocks
         counts = blocks.counts0.copy() if blocks is not None else None
         overflow = list(
             zip(res.overflow_rows.tolist(), res.overflow_scores.tolist())
         )
         of_idx = 0
+        dead = False  # lane-intrinsic infeasibility: stop re-scoring
 
         def commit(row: int) -> None:
             used[row] += a.ask
-            taken_rows.add(row)
+            placed_on_node[row] = placed_on_node.get(row, 0) + 1
             if blocks is not None:
                 for b in range(blocks.num_blocks):
                     v = blocks.value_ids[b, row]
@@ -714,7 +1414,10 @@ def repair_batch_conflicts(cluster, asks: list, results: list) -> list[bool]:
                 return False
             if not np.all(used[row] + a.ask <= capacity[row]):
                 return False
-            if a.distinct_hosts and row in taken_rows:
+            mine = placed_on_node.get(row, 0)
+            if a.distinct_hosts and (a.job_counts[row] + mine) > 0:
+                return False
+            if a.slot_caps is not None and mine >= a.slot_caps[row]:
                 return False
             if blocks is not None:
                 for b in range(blocks.num_blocks):
@@ -725,13 +1428,37 @@ def repair_batch_conflicts(cluster, asks: list, results: list) -> list[bool]:
                         return False
             return True
 
+        def rescore(i: int) -> str:
+            """Exact re-place of placement ``i``. Returns 'placed',
+            'contention' (fits alone, not under the overlay), or
+            'intrinsic'."""
+            pm = np.zeros(capacity.shape[0], dtype=np.float32)
+            for r, m in placed_on_node.items():
+                pm[r] = m
+            row, sc = _rescore_pick(
+                capacity, used, a, pm, counts, algorithm_spread
+            )
+            if row >= 0:
+                res.node_rows[i] = row
+                res.scores[i] = sc
+                commit(row)
+                return "placed"
+            # would it fit with only this lane's own placements applied?
+            lane_used = used0 + pm[:, None] * a.ask[None, :]
+            row, _sc = _rescore_pick(
+                capacity, lane_used, a, pm, counts, algorithm_spread
+            )
+            return "contention" if row >= 0 else "intrinsic"
+
         for i, row in enumerate(res.node_rows.tolist()):
-            if row < 0:
-                continue
-            if acceptable(row):
+            if row >= 0 and acceptable(row):
                 commit(row)
                 continue
-            # conflicted: advance through overflow candidates
+            if dead:
+                res.node_rows[i] = -1
+                res.scores[i] = -np.inf
+                continue
+            # conflicted or unplaced: advance through overflow candidates
             repl = -1
             while of_idx < len(overflow):
                 cand, sc = overflow[of_idx]
@@ -742,8 +1469,15 @@ def repair_batch_conflicts(cluster, asks: list, results: list) -> list[bool]:
                     res.scores[i] = sc
                     commit(cand)
                     break
-            if repl < 0:
+            if repl >= 0:
+                continue
+            outcome = rescore(i)
+            if outcome == "contention":
                 ok = False
                 break
+            if outcome == "intrinsic":
+                res.node_rows[i] = -1
+                res.scores[i] = -np.inf
+                dead = True
         ok_lanes.append(ok)
     return ok_lanes

@@ -134,7 +134,18 @@ class GenericScheduler:
         placements = self._start_attempt()
         if placements and self.job is not None:
             ct, tg_order = self._build_group_asks(placements)
-            results = self.kernel.place(ct, [t[3] for t in tg_order])
+            asks = [t[3] for t in tg_order]
+            results = self.kernel.place(ct, asks)
+            # the repair walk is also the single-eval safety net: it
+            # resolves cross-TG conflicts within this plan and re-places
+            # kernel shortfalls (e.g. chunked-path truncation) by exact
+            # host re-score before they read as placement failures
+            from ..device.score import repair_batch_conflicts
+
+            repair_batch_conflicts(
+                ct, asks, results,
+                algorithm_spread=self.kernel.algorithm_spread,
+            )
             self._finish_placements(ct, tg_order, results)
             self._adjust_queued()
         return self._submit_attempt()
@@ -638,6 +649,9 @@ class GenericScheduler:
             # (generic_sched.go:193-212)
             blocked = ev.create_blocked_eval({}, True, "", self.failed_tg_allocs)
             blocked.status_description = BLOCKED_EVAL_FAILED_PLACEMENTS_DESC
+            # carry the unplaced counts so parked blocked evals are
+            # auditable (bench accounting: placed + blocked == total)
+            blocked.queued_allocations = dict(self.queued_allocs)
             # record the snapshot the failure was computed against, so the
             # blocked-evals tracker can detect missed unblocks
             blocked.snapshot_index = getattr(self.snapshot, "index", 0)

@@ -80,6 +80,9 @@ class Worker:
                 continue
             try:
                 if len(batch) == 1:
+                    # batch accounting reconciliation: evals dequeued solo
+                    # never enter a batched pass at all
+                    metrics.incr("nomad.worker.solo_evals")
                     self._run_one(*batch[0])
                 else:
                     self._run_batch(batch)
@@ -160,16 +163,23 @@ class Worker:
         lane_ok: list[bool] = []
         if all_asks:
             try:
+                kernel = prepared[0][2].kernel
                 with metrics.timer("nomad.worker.invoke_scheduler"):
-                    results = prepared[0][2].kernel.place(ct, all_asks)
-                # every lane scored against the same snapshot usage —
-                # true-argmax lanes pile onto the same best nodes, so
-                # resolve cross-lane overcommit host-side from each
-                # lane's overflow candidates instead of letting the
-                # applier partially reject whole evals
+                    # decorrelate: each lane scores a disjoint node stripe
+                    # (the vector analog of per-worker shuffle sampling,
+                    # stack.go:74-90) so concurrent lanes stop argmaxing
+                    # onto the same nodes; repair re-scores any remainder
+                    results = kernel.place(
+                        ct, all_asks, decorrelate=True, overflow=32
+                    )
                 from ..device.score import repair_batch_conflicts
 
-                lane_ok = repair_batch_conflicts(ct, all_asks, results)
+                lane_ok = repair_batch_conflicts(
+                    ct,
+                    all_asks,
+                    results,
+                    algorithm_spread=kernel.algorithm_spread,
+                )
             except Exception:
                 # shared pass failed — every prepared eval falls back to
                 # the individual path rather than dying unacked

@@ -1,0 +1,277 @@
+"""Chunked spread placement (place_spread_chunked_kernel): large
+spread-coupled groups place CHUNK instances per step with the per-value
+boost tables frozen within a chunk. Exactness is deliberately traded for
+~CHUNK× less sequential depth (VERDICT r3 #2); these tests bound the
+trade against the stepwise NumPy oracle from test_value_scan:
+
+- every placement is feasible (capacity, eligibility, caps);
+- final per-value spread counts deviate from the oracle's by at most the
+  chunk size (boost staleness is bounded by construction);
+- total claimed score stays within a small relative band of the oracle's.
+
+Reference framing: the Go scheduler itself is not exact-greedy — it
+samples ≥100 nodes for spread jobs (scheduler/stack.go:165-174), so
+bounded within-chunk staleness is a *tighter* approximation than the
+baseline's sampling.
+"""
+
+import numpy as np
+
+from nomad_tpu.device.score import (
+    BLOCK_EVEN_SPREAD,
+    BLOCK_TARGET_SPREAD,
+    CHUNK,
+    EXACT_SCAN_MAX_COUNT,
+    PlacementKernel,
+    repair_batch_conflicts,
+)
+
+from test_value_scan import blocks_of, make_ask, make_cluster, naive_greedy
+
+
+def place_chunked(ct, a, algorithm="binpack"):
+    kernel = PlacementKernel(algorithm)
+    assert not kernel._needs_exact_scan(a), "fixture must take the chunked path"
+    res = kernel.place(ct, [a])[0]
+    return res
+
+
+def replay_scores(ct, a, rows):
+    """Re-derive each placement's stepwise score for a given placement
+    sequence with the oracle's scoring rules (counts updated per step) —
+    measures realized quality independent of the kernel's claimed scores,
+    which are evaluated at frozen chunk state."""
+    from test_value_scan import even_boost
+
+    used = ct.used.copy()
+    placed = np.zeros(ct.padded_n, dtype=np.int64)
+    blocks = a.blocks
+    counts = blocks.counts0.copy() if blocks is not None else None
+    out = []
+    for r in rows:
+        if r < 0:
+            continue
+        prop = used[r] + a.ask
+        free = np.where(ct.capacity[r] > 0, (ct.capacity[r] - prop) / ct.capacity[r], 1.0)
+        binpack = min(max(20.0 - 10.0 ** free[0] - 10.0 ** free[1], 0.0), 18.0) / 18.0
+        comps = [binpack]
+        if placed[r] > 0:
+            comps.append(-(placed[r] + 1.0) / max(a.desired_total, 1))
+        if a.has_affinities:
+            comps.append(float(a.affinity_scores[r]))
+        boost = 0.0
+        if blocks is not None:
+            for b in range(blocks.num_blocks):
+                kind = blocks.kinds[b]
+                v = blocks.value_ids[b, r]
+                if kind == BLOCK_EVEN_SPREAD:
+                    boost += even_boost(counts[b, v], counts[b]) if v >= 0 else -1.0
+                elif kind == BLOCK_TARGET_SPREAD:
+                    if v < 0 or blocks.desired[b, v] <= 0:
+                        boost += -1.0
+                    else:
+                        d = blocks.desired[b, v]
+                        boost += ((d - (counts[b, v] + 1.0)) / d) * blocks.weights[b]
+            if blocks.has_spreads and boost != 0.0:
+                comps.append(boost)
+        out.append(sum(comps) / len(comps))
+        used[r] += a.ask
+        placed[r] += 1
+        if blocks is not None:
+            for b in range(blocks.num_blocks):
+                v = blocks.value_ids[b, r]
+                if v >= 0:
+                    counts[b, v] += 1
+    return np.array(out)
+
+
+def final_value_counts(blocks, rows):
+    c = blocks.counts0.copy()
+    for r in rows:
+        if r < 0:
+            continue
+        for b in range(blocks.num_blocks):
+            v = blocks.value_ids[b, r]
+            if v >= 0:
+                c[b, v] += 1
+    return c
+
+
+def assert_feasible(ct, a, rows):
+    used = ct.used.copy()
+    for r in rows:
+        assert r >= 0
+        assert a.eligible[r]
+        used[r] += a.ask
+        assert np.all(used[r] <= ct.capacity[r] + 1e-3)
+
+
+def test_chunked_even_spread_quality():
+    ct = make_cluster(256, seed=20, load_max=0.3)
+    nv = 8
+    vids = (np.arange(ct.padded_n) % nv).astype(np.int32)
+    b = blocks_of(ct, [(BLOCK_EVEN_SPREAD, vids,
+                        np.zeros(nv, dtype=np.float32), None, None, 1.0)])
+    count = 96
+    assert count > EXACT_SCAN_MAX_COUNT
+    a = make_ask(ct, count=count, blocks=b)
+    res = place_chunked(ct, a)
+    rows = res.node_rows
+    assert int((rows >= 0).sum()) == count
+    assert_feasible(ct, a, rows)
+
+    rows_o, scores_o = naive_greedy(ct, a)
+    c_k = final_value_counts(b, rows)
+    c_o = final_value_counts(b, rows_o)
+    # boost staleness is bounded by the chunk size per refresh
+    assert np.abs(c_k - c_o).max() <= CHUNK
+    # even spread actually happened: kernel counts are near-uniform
+    assert c_k.max() - c_k.min() <= CHUNK
+    # realized quality: replay the kernel's sequence through the
+    # stepwise scorer — the claimed in-chunk scores are frozen-state
+    # artifacts, but the actual placements must score near the oracle's
+    total_k = float(replay_scores(ct, a, rows).sum())
+    total_o = float(scores_o[rows_o >= 0].sum())
+    assert total_k >= total_o - 0.05 * abs(total_o) - 1.0
+
+
+def test_chunked_target_spread_honors_split():
+    ct = make_cluster(128, seed=21, load_max=0.2)
+    vids = (np.arange(ct.padded_n) % 2).astype(np.int32)
+    count = 80
+    desired = np.array([0.7 * count, 0.3 * count], dtype=np.float32)
+    b = blocks_of(ct, [(BLOCK_TARGET_SPREAD, vids,
+                        np.zeros(2, dtype=np.float32), desired, None, 1.0)])
+    a = make_ask(ct, count=count, blocks=b)
+    res = place_chunked(ct, a)
+    rows = res.node_rows
+    assert int((rows >= 0).sum()) == count
+    assert_feasible(ct, a, rows)
+    placed_v0 = int((vids[rows[rows >= 0]] == 0).sum())
+    # 70/30 split within one chunk of slack
+    assert abs(placed_v0 - 0.7 * count) <= CHUNK
+
+
+def test_chunked_multi_block_feasible_and_spread():
+    ct = make_cluster(192, seed=22, load_max=0.4)
+    vids_rack = (np.arange(ct.padded_n) % 6).astype(np.int32)
+    vids_dc = (np.arange(ct.padded_n) % 3).astype(np.int32)
+    b = blocks_of(ct, [
+        (BLOCK_EVEN_SPREAD, vids_rack, np.zeros(6, dtype=np.float32),
+         None, None, 0.7),
+        (BLOCK_EVEN_SPREAD, vids_dc, np.zeros(6, dtype=np.float32),
+         None, None, 0.3),
+    ])
+    a = make_ask(ct, count=60, blocks=b, affinities=True)
+    res = place_chunked(ct, a)
+    rows = res.node_rows
+    assert int((rows >= 0).sum()) == 60
+    assert_feasible(ct, a, rows)
+    c_k = final_value_counts(b, rows)
+    assert c_k[0, :6].max() - c_k[0, :6].min() <= CHUNK
+
+
+def test_chunked_emits_overflow_candidates():
+    ct = make_cluster(128, seed=23, load_max=0.2)
+    vids = (np.arange(ct.padded_n) % 4).astype(np.int32)
+    b = blocks_of(ct, [(BLOCK_EVEN_SPREAD, vids,
+                        np.zeros(4, dtype=np.float32), None, None, 1.0)])
+    a = make_ask(ct, count=48, blocks=b)
+    res = PlacementKernel("binpack").place(ct, [a], overflow=16)[0]
+    assert res.overflow_rows.shape[0] == 16
+    assert int((res.overflow_rows >= 0).sum()) == 16
+
+
+def test_chunked_respects_capacity_exhaustion():
+    """A cluster that can only hold part of the ask: the valid picks form
+    a prefix and the remainder is −1."""
+    ct = make_cluster(8, seed=24, load_max=0.0)
+    ct.capacity[:8, 0] = 1000.0
+    ct.capacity[:8, 1] = 1024.0
+    vids = (np.arange(ct.padded_n) % 2).astype(np.int32)
+    b = blocks_of(ct, [(BLOCK_EVEN_SPREAD, vids,
+                        np.zeros(2, dtype=np.float32), None, None, 1.0)])
+    a = make_ask(ct, count=40, blocks=b, cpu=900, mem=900)  # 8 fit
+    res = place_chunked(ct, a)
+    rows = res.node_rows
+    assert int((rows >= 0).sum()) == 8
+    assert np.all(rows[:8] >= 0)
+    assert np.all(rows[8:] == -1)
+
+
+def test_batch_decorrelation_and_repair_large_lanes():
+    """Several large spread lanes in one pass with decorrelate=True: after
+    repair, the combined placements of all lanes never overcommit any
+    node, and no lane is aborted (the r3 failure mode: 92.9% of lanes
+    fell back to the individual path)."""
+    ct = make_cluster(512, seed=25, load_max=0.3)
+    nv = 8
+    vids = (np.arange(ct.padded_n) % nv).astype(np.int32)
+    lanes = []
+    for s in range(4):
+        b = blocks_of(ct, [(BLOCK_EVEN_SPREAD, vids,
+                            np.zeros(nv, dtype=np.float32), None, None, 1.0)])
+        lanes.append(make_ask(ct, count=64, seed=30 + s, blocks=b))
+    kernel = PlacementKernel("binpack")
+    results = kernel.place(ct, lanes, decorrelate=True, overflow=32)
+    ok = repair_batch_conflicts(ct, lanes, results)
+    assert ok == [True] * 4
+    total = np.zeros_like(ct.used)
+    for a, r in zip(lanes, results):
+        placed = r.node_rows[r.node_rows >= 0]
+        assert placed.shape[0] == a.count
+        for row in placed:
+            total[row] += a.ask
+    assert np.all(ct.used + total <= ct.capacity + 1e-3)
+
+
+def test_repair_rescore_places_conflicts_without_abort():
+    """Two identical lanes, no decorrelation, tiny overflow: the second
+    lane's conflicts must be re-placed by the exact host re-score instead
+    of aborting the lane (VERDICT r3 #1b)."""
+    ct = make_cluster(64, seed=26, load_max=0.0)
+    ct.capacity[:64, 0] = 1000.0
+    ct.capacity[:64, 1] = 1024.0
+    a1 = make_ask(ct, count=20, seed=1, cpu=900, mem=900)
+    a2 = make_ask(ct, count=20, seed=2, cpu=900, mem=900)
+    kernel = PlacementKernel("binpack")
+    results = kernel.place(ct, [a1, a2], overflow=4)
+    # without decorrelation both lanes picked the same 20 nodes
+    ok = repair_batch_conflicts(ct, [a1, a2], results)
+    assert ok == [True, True]
+    rows1 = set(results[0].node_rows.tolist())
+    rows2 = set(results[1].node_rows.tolist())
+    assert not rows1 & rows2
+    assert all(r >= 0 for r in rows2)
+
+
+def test_repair_contention_flags_lane_for_individual_rerun():
+    """When the cluster genuinely can't hold both lanes, the starved lane
+    is flagged (ok=False) because it WOULD fit alone — the individual
+    path should retry it against fresh state."""
+    ct = make_cluster(4, seed=27, load_max=0.0)
+    ct.capacity[:4, 0] = 1000.0
+    ct.capacity[:4, 1] = 1024.0
+    a1 = make_ask(ct, count=4, seed=1, cpu=900, mem=900)
+    a2 = make_ask(ct, count=2, seed=2, cpu=900, mem=900)
+    kernel = PlacementKernel("binpack")
+    results = kernel.place(ct, [a1, a2], overflow=4)
+    ok = repair_batch_conflicts(ct, [a1, a2], results)
+    assert ok == [True, False]
+
+
+def test_repair_intrinsic_failure_keeps_lane():
+    """A lane that can't fully place even alone (count > cluster space)
+    keeps ok=True with −1 rows — it would fail individually too, and
+    becomes a blocked eval instead of a pointless re-run."""
+    ct = make_cluster(2, seed=28, load_max=0.0)
+    ct.capacity[:2, 0] = 1000.0
+    ct.capacity[:2, 1] = 1024.0
+    a = make_ask(ct, count=5, seed=1, cpu=900, mem=900)
+    kernel = PlacementKernel("binpack")
+    results = kernel.place(ct, [a])
+    ok = repair_batch_conflicts(ct, [a], results)
+    assert ok == [True]
+    rows = results[0].node_rows
+    assert int((rows >= 0).sum()) == 2
+    assert int((rows == -1).sum()) == 3

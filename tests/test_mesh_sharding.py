@@ -79,20 +79,29 @@ def test_value_scan_kernel_sharded_matches_single_device():
     assert (np.asarray(c) >= 0).all()
 
 
+def _split_fused(fused, k):
+    """closed-form kernel returns [G, 2k] i32: rows ++ bitcast scores."""
+    fused = np.asarray(fused)
+    return fused[:, :k], fused[:, k:].view(np.float32)
+
+
 def test_closed_form_kernel_sharded_matches_single_device():
     batch = graft._closed_form_batch(n_nodes=512, n_groups=8, count=16)
 
-    ref_c, ref_s = place_closed_form_kernel(**batch, max_j=16, k=16)
+    ref_c, ref_s = _split_fused(
+        place_closed_form_kernel(**batch, max_j=16, k=16), 16
+    )
 
     mesh = _mesh()
     specs = {k: SPECS[k] for k in batch}
     sharded = _shard(batch, mesh, specs)
     with mesh:
-        c, s = place_closed_form_kernel(**sharded, max_j=16, k=16)
-        jax.block_until_ready((c, s))
+        fused = place_closed_form_kernel(**sharded, max_j=16, k=16)
+        jax.block_until_ready(fused)
+    c, s = _split_fused(fused, 16)
 
-    np.testing.assert_array_equal(np.asarray(c), np.asarray(ref_c))
-    np.testing.assert_allclose(np.asarray(s), np.asarray(ref_s), rtol=1e-6)
+    np.testing.assert_array_equal(c, ref_c)
+    np.testing.assert_allclose(s, ref_s, rtol=1e-6)
 
 
 def test_score_matrix_kernel_node_sharded():
@@ -127,15 +136,18 @@ def test_mesh_shapes_1x8_and_4x2():
     """The layout must work at other mesh aspect ratios (different dp/mp
     splits of the same 8 chips)."""
     batch = graft._closed_form_batch(n_nodes=512, n_groups=8, count=8)
-    ref_c, ref_s = place_closed_form_kernel(**batch, max_j=8, k=8)
+    ref_c, _ = _split_fused(
+        place_closed_form_kernel(**batch, max_j=8, k=8), 8
+    )
     for dp, mp in [(1, 8), (4, 2)]:
         mesh = _mesh(dp, mp)
         specs = {k: SPECS[k] for k in batch}
         sharded = _shard(batch, mesh, specs)
         with mesh:
-            c, s = place_closed_form_kernel(**sharded, max_j=8, k=8)
-            jax.block_until_ready((c, s))
-        np.testing.assert_array_equal(np.asarray(c), np.asarray(ref_c))
+            fused = place_closed_form_kernel(**sharded, max_j=8, k=8)
+            jax.block_until_ready(fused)
+        c, _ = _split_fused(fused, 8)
+        np.testing.assert_array_equal(c, ref_c)
 
 
 def test_dryrun_multichip_in_process(monkeypatch):
