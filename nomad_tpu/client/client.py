@@ -32,6 +32,12 @@ log = logging.getLogger("nomad_tpu.client")
 
 ALLOC_SYNC_INTERVAL = 0.2  # client.go:99-101 allocSyncIntv
 
+# terminal alloc dirs retained before the GC sweep reclaims the oldest
+# (client/gc.go MaxAllocs-style bound; disk-usage triggers reduce to a
+# count bound in this build — the dirs are tiny without artifacts)
+GC_MAX_TERMINAL_ALLOCS = 50
+GC_INTERVAL = 1.0
+
 
 class ServerRPC(Protocol):
     def register_node(self, node: Node) -> None: ...
@@ -54,13 +60,22 @@ class Client:
         heartbeat_interval: Optional[float] = None,
         host_volumes: Optional[dict] = None,
         serve_endpoints: bool = True,
+        driver_mode: str = "inprocess",
     ):
         self.rpc = rpc
         self.data_dir = data_dir
         self._serve_endpoints = serve_endpoints
         self.endpoints = None
         self.state_db = ClientStateDB(data_dir)
-        self.drivers = builtin_drivers()
+        if driver_mode == "plugin":
+            # out-of-process driver plugins (driver.proto contract over
+            # stdio NDJSON — client/plugin.py); tasks and their reattach
+            # handles survive plugin AND client restarts
+            from .plugin import plugin_drivers
+
+            self.drivers = plugin_drivers()
+        else:
+            self.drivers = builtin_drivers()
         self.node = fingerprint_node(node, data_dir=data_dir, drivers=self.drivers)
         if host_volumes:
             # client config host_volume blocks surface on the node for the
@@ -74,6 +89,18 @@ class Client:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._last_index = 0
+        # heartbeatstop (client/heartbeatstop.go:11-40): last server
+        # contact; allocs with stop_after_client_disconnect stop when the
+        # client has been out of contact longer than their threshold
+        self._last_ok_heartbeat = time.time()
+        self._heartbeat_stopped: set[str] = set()
+        self.gc_max_terminal_allocs = GC_MAX_TERMINAL_ALLOCS
+        # terminal alloc ids in completion order (oldest first) for GC
+        self._terminal_order: list[str] = []
+        # alloc ids whose TERMINAL status the server has acknowledged —
+        # only these are GC-eligible (destroying durable state before the
+        # ack would let a post-partition reconcile re-run the alloc)
+        self._acked_terminal: set[str] = set()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -92,6 +119,7 @@ class Client:
             (self._heartbeat_loop, "heartbeat"),
             (self._watch_allocations, "alloc-watch"),
             (self._sync_loop, "alloc-sync"),
+            (self._gc_loop, "gc"),
         ):
             t = threading.Thread(target=fn, name=f"client-{name}", daemon=True)
             t.start()
@@ -109,6 +137,10 @@ class Client:
             t.join(timeout=2)
         if self.endpoints is not None:
             self.endpoints.stop()
+        for d in self.drivers.values():
+            close = getattr(d, "close", None)
+            if close is not None:
+                close()
         self.state_db.close()
 
     # -- restore (client/state StateDB; task_runner.go:488-519) -----------
@@ -143,11 +175,85 @@ class Client:
         while not self._stop.is_set():
             try:
                 ttl = self.rpc.heartbeat(self.node.id)
+                self._last_ok_heartbeat = time.time()
+                self._heartbeat_stopped.clear()
             except Exception:
-                log.exception("heartbeat failed")
+                log.warning("heartbeat failed", exc_info=True)
                 ttl = 1.0
+                self._check_heartbeat_stop()
             interval = self.heartbeat_interval or max(ttl / 2.0, 0.05)
             self._stop.wait(interval)
+
+    def _check_heartbeat_stop(self) -> None:
+        """heartbeatstop (client/heartbeatstop.go:11-40): when server
+        contact has been lost longer than a group's
+        ``stop_after_client_disconnect``, stop its allocs locally — the
+        server has already considered them lost and replaced them, so
+        letting them run risks a split-brain double-run."""
+        elapsed = time.time() - self._last_ok_heartbeat
+        with self._lock:
+            runners = list(self.runners.items())
+        for alloc_id, runner in runners:
+            if alloc_id in self._heartbeat_stopped or runner._destroyed:
+                continue
+            a = runner.alloc
+            tg = (
+                a.job.lookup_task_group(a.task_group)
+                if a.job is not None
+                else None
+            )
+            threshold = (
+                tg.stop_after_client_disconnect_s if tg is not None else None
+            )
+            if threshold is not None and elapsed >= threshold:
+                log.info(
+                    "heartbeatstop: stopping alloc %s after %.1fs without "
+                    "server contact (threshold %.1fs)",
+                    alloc_id[:8], elapsed, threshold,
+                )
+                self._heartbeat_stopped.add(alloc_id)
+                runner.stop()
+
+    # -- terminal-alloc GC (client/gc.go) ----------------------------------
+    def _gc_loop(self) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(GC_INTERVAL)
+            try:
+                self.gc_sweep()
+            except Exception:
+                log.exception("alloc GC sweep failed")
+
+    def gc_sweep(self) -> None:
+        """Reclaim the oldest terminal alloc dirs beyond the retention
+        bound (client/gc.go: disk-driven destroy of terminal allocs; this
+        build bounds by count). Allocs whose final status is still
+        awaiting server sync are NOT reclaimed — destroying the runner
+        and its durable state before the server learns the alloc
+        finished would let a post-partition reconcile re-run it."""
+        with self._lock:
+            self._terminal_order = [
+                aid for aid in self._terminal_order if aid in self.runners
+            ]
+            for alloc_id, runner in self.runners.items():
+                if runner.is_terminal() and alloc_id not in self._terminal_order:
+                    self._terminal_order.append(alloc_id)
+            eligible = [
+                aid
+                for aid in self._terminal_order
+                if aid in self._acked_terminal
+            ]
+            excess = len(eligible) - self.gc_max_terminal_allocs
+            victims = eligible[: max(excess, 0)]
+        for alloc_id in victims:
+            with self._lock:
+                runner = self.runners.pop(alloc_id, None)
+                if alloc_id in self._terminal_order:
+                    self._terminal_order.remove(alloc_id)
+            self._acked_terminal.discard(alloc_id)  # bound the ack set
+            if runner is not None:
+                runner.destroy()
+            self.state_db.delete_alloc(alloc_id)
+            log.info("gc: reclaimed terminal alloc %s", alloc_id[:8])
 
     # -- alloc pull + reconcile (client.go watchAllocations) ---------------
     def _watch_allocations(self) -> None:
@@ -221,6 +327,9 @@ class Client:
             if batch:
                 try:
                     self.rpc.update_allocs(batch)
+                    self._acked_terminal.update(
+                        u.id for u in batch if u.terminal_status()
+                    )
                 except Exception:
                     log.exception("alloc status sync failed")
                     with self._lock:

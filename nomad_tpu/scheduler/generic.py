@@ -145,6 +145,10 @@ class GenericScheduler:
             repair_batch_conflicts(
                 ct, asks, results,
                 algorithm_spread=self.kernel.algorithm_spread,
+                # single-eval: no fresh state to re-run against, so an
+                # unplaceable placement fails into the blocked-eval
+                # accounting instead of aborting the lane
+                fail_on_contention=True,
             )
             self._finish_placements(ct, tg_order, results)
             self._adjust_queued()
@@ -204,6 +208,7 @@ class GenericScheduler:
         ev = self.eval
         self.failed_tg_allocs = {}
         self.followup_evals = []
+        self._preempt_rank_cache = {}  # per-attempt: ct/used change
         self.job = self.snapshot.job_by_id(ev.namespace, ev.job_id)
         self.plan = ev.make_plan(self.job)
         self.plan.snapshot_index = getattr(self.snapshot, "index", 0)
@@ -479,30 +484,9 @@ class GenericScheduler:
                 self.plan.append_alloc(alloc)
 
     def _assign_devices(self, tg, node_id):
-        """Concrete device-instance assignment for one placement, seeing
-        both snapshot allocs and allocations/evictions already in this
-        plan (scheduler/device.py; reference rank.go:388-434).
-        Returns (devices | None, ok): ok is False only when the group asks
-        for devices and the node can't satisfy them."""
-        from .device import assign_devices, collect_in_use, group_device_asks
+        from .device import assign_devices_for_plan
 
-        if not group_device_asks(tg):
-            return None, True
-        node = self.snapshot.node_by_id(node_id)
-        if node is None:
-            return None, False
-        stopped = {a.id for a in self.plan.node_update.get(node_id, [])}
-        stopped |= {
-            a.id for a in self.plan.node_preemptions.get(node_id, [])
-        }
-        live = [
-            a
-            for a in self.snapshot.allocs_by_node(node_id)
-            if a.id not in stopped
-        ]
-        live.extend(self.plan.node_allocation.get(node_id, []))
-        devices = assign_devices(node, collect_in_use(live), tg)
-        return devices, devices is not None
+        return assign_devices_for_plan(self.snapshot, self.plan, tg, node_id)
 
     @staticmethod
     def _record_exhaustion(metric, ct, ga) -> None:
@@ -548,12 +532,21 @@ class GenericScheduler:
 
     def _try_preempt(self, ct, pr, tg_name, ga, comparable) -> bool:
         """Preemption fallback for one failed placement: one device pass
-        finds the cheapest feasible victim set across all nodes
-        (device/preempt.py); victims are evicted in-plan and the placement
-        lands on their node (generic_sched.go:795 handlePreemptions)."""
+        per GROUP ranks every node's cheapest feasible victim set
+        (device/preempt.py — the shortlist is cached across this plan's
+        failures, so G failed placements cost one [N, V] kernel pass, not
+        G); the final victim set on a shortlisted node is chosen by the
+        reference-exact host greedy (preempt_host.select_victims:
+        maxParallel penalty, reserved ports, device instances). Victims
+        are evicted in-plan and the placement lands on their node
+        (generic_sched.go:795 handlePreemptions)."""
         if not self._preemption_enabled() or self.job is None:
             return False
-        from ..device.preempt import PREEMPTION_PRIORITY_DELTA, find_preemptions
+        from ..device.preempt import (
+            PREEMPTION_PRIORITY_DELTA,
+            rank_preemption_nodes,
+        )
+        from .preempt_host import select_victims
 
         if self.job.priority < PREEMPTION_PRIORITY_DELTA:
             return False
@@ -574,14 +567,41 @@ class GenericScheduler:
             for allocs in self.plan.node_preemptions.values()
             for a in allocs
         }
-        row, victim_ids = find_preemptions(
-            ct,
-            self.snapshot,
-            self.job,
-            ga.ask,
-            eligible,
-            exclude_ids=already_preempted,
-        )
+        cache = getattr(self, "_preempt_rank_cache", None)
+        if cache is None:
+            cache = self._preempt_rank_cache = {}
+        shortlist = cache.get(tg_name)
+        if shortlist is None:
+            shortlist = rank_preemption_nodes(
+                ct,
+                self.snapshot,
+                self.job,
+                ga.ask,
+                eligible,
+                exclude_ids=already_preempted,
+            )
+            cache[tg_name] = shortlist
+        tg = self.job.lookup_task_group(tg_name)
+        row, victim_ids = None, []
+        for cand_row in shortlist:
+            # the shortlist is cached per group, but eligibility is
+            # recomputed per failure (distinct_hosts excludes nodes this
+            # plan already used) — stale rows are skipped, not trusted
+            if not eligible[cand_row]:
+                continue
+            ids = select_victims(
+                ct,
+                self.snapshot,
+                self.job,
+                tg,
+                ga.ask,
+                cand_row,
+                plan=self.plan,
+                exclude_ids=already_preempted,
+            )
+            if ids:
+                row, victim_ids = cand_row, ids
+                break
         if row is None or not victim_ids:
             return False
         node_id = ct.node_ids[row]
@@ -621,11 +641,9 @@ class GenericScheduler:
                 # victims chosen by resource distance didn't free the
                 # needed device instances — abandon this preemption
                 # rather than shipping a device-less alloc
-                for vid in victim_ids:
-                    allocs = self.plan.node_preemptions.get(node_id, [])
-                    self.plan.node_preemptions[node_id] = [
-                        a for a in allocs if a.id != vid
-                    ]
+                from .device import rollback_plan_preemptions
+
+                rollback_plan_preemptions(self.plan, node_id, victim_ids)
                 return False
             if devices:
                 alloc.allocated_devices = devices

@@ -130,38 +130,79 @@ class SystemScheduler:
                 node_id = ct.node_ids[row]
                 if (node_id, tg.name) in live_by_node_group:
                     continue  # already running there
+                preempted_ids: list[str] = []
                 if not fits_np[row]:
-                    m = AllocMetric(nodes_evaluated=1)
-                    m.exhausted_node(node_id, "resources")
-                    self._record_failure(tg.name, m)
-                    continue
-                if ga.slot_caps is not None and ga.slot_caps[row] < 1:
-                    # device instances exist but are all held
+                    preempted_ids = self._try_preempt_node(ct, tg, row, ga.ask)
+                    if not preempted_ids:
+                        m = AllocMetric(nodes_evaluated=1)
+                        m.exhausted_node(node_id, "resources")
+                        self._record_failure(tg.name, m)
+                        continue
+                if (
+                    not preempted_ids
+                    and ga.slot_caps is not None
+                    and ga.slot_caps[row] < 1
+                ):
+                    # device instances exist but are all held — system
+                    # preemption may free them (PreemptForDevice)
+                    preempted_ids = self._try_preempt_node(ct, tg, row, ga.ask)
+                    if not preempted_ids:
+                        m = AllocMetric(nodes_evaluated=1)
+                        m.exhausted_node(node_id, "devices")
+                        self._record_failure(tg.name, m)
+                        continue
+                alloc_id = new_id()
+                # victims enter the plan BEFORE device assignment so
+                # collect_in_use sees their instances as freed; a failed
+                # assignment rolls the eviction back (the generic path's
+                # dev_ok contract, generic.py _try_preempt)
+                victim_total = None
+                for vid in preempted_ids:
+                    victim = self.snapshot.alloc_by_id(vid)
+                    if victim is not None:
+                        self.plan.append_preempted_alloc(victim, alloc_id)
+                        vec = victim.comparable_resources().to_vector()
+                        victim_total = (
+                            vec if victim_total is None else victim_total + vec
+                        )
+                devices, dev_ok = self._assign_devices(tg, node_id)
+                if not dev_ok:
+                    from .device import rollback_plan_preemptions
+
+                    rollback_plan_preemptions(
+                        self.plan, node_id, preempted_ids
+                    )
                     m = AllocMetric(nodes_evaluated=1)
                     m.exhausted_node(node_id, "devices")
                     self._record_failure(tg.name, m)
                     continue
-                devices = self._assign_devices(tg, node_id)
                 metric = AllocMetric(nodes_evaluated=1)
                 metric.scores[f"{node_id}.score"] = float(finals[row])
-                self.plan.append_alloc(
-                    Allocation(
-                        id=new_id(),
-                        namespace=self.job.namespace,
-                        eval_id=ev.id,
-                        name=f"{self.job.id}.{tg.name}[0]",
-                        node_id=node_id,
-                        job_id=self.job.id,
-                        job=self.job,
-                        job_version=self.job.version,
-                        task_group=tg.name,
-                        resources=comparable.copy(),
-                        desired_status=ALLOC_DESIRED_RUN,
-                        client_status="pending",
-                        metrics=metric,
-                        allocated_devices=devices or [],
-                    )
+                alloc = Allocation(
+                    id=alloc_id,
+                    namespace=self.job.namespace,
+                    eval_id=ev.id,
+                    name=f"{self.job.id}.{tg.name}[0]",
+                    node_id=node_id,
+                    job_id=self.job.id,
+                    job=self.job,
+                    job_version=self.job.version,
+                    task_group=tg.name,
+                    resources=comparable.copy(),
+                    desired_status=ALLOC_DESIRED_RUN,
+                    client_status="pending",
+                    metrics=metric,
+                    allocated_devices=devices or [],
                 )
+                if preempted_ids:
+                    alloc.preempted_allocations = list(preempted_ids)
+                    if victim_total is not None:
+                        ct.used[row] -= victim_total
+                # every placement debits the (private) usage overlay so
+                # later task groups' fit checks and victim selection see
+                # this plan's own load
+                ct.used[row] += ga.ask
+                self.plan.append_alloc(alloc)
             # stop allocs on nodes no longer eligible (e.g. constraint
             # change) — but NOT draining nodes: those drain via the
             # NodeDrainer's migrate marks, not eligibility loss
@@ -177,24 +218,42 @@ class SystemScheduler:
 
         return self._submit()
 
-    def _assign_devices(self, tg, node_id):
-        """Concrete device instances for a system placement, seeing both
-        snapshot allocs and in-plan changes (scheduler/device.py)."""
-        from .device import assign_devices, collect_in_use, group_device_asks
+    def _try_preempt_node(self, ct, tg, row, ask_vec) -> list[str]:
+        """System-job preemption on one node (the node IS the target for
+        system placements — no search needed). Enabled by default per
+        SchedulerConfiguration.PreemptionConfig.SystemSchedulerEnabled
+        (nomad/structs/operator.go:164-169, scheduler_system.go:27);
+        victim selection is the reference-exact host greedy
+        (preempt_host.select_victims: maxParallel, ports, devices)."""
+        cfg = self.snapshot.scheduler_config()
+        if not cfg.preemption_system_enabled or self.job is None:
+            return []
+        from ..device.preempt import PREEMPTION_PRIORITY_DELTA
+        from .preempt_host import select_victims
 
-        if not group_device_asks(tg):
-            return None
-        node = self.snapshot.node_by_id(node_id)
-        if node is None:
-            return None
-        stopped = {a.id for a in self.plan.node_update.get(node_id, [])}
-        live = [
-            a
-            for a in self.snapshot.allocs_by_node(node_id)
-            if a.id not in stopped
-        ]
-        live.extend(self.plan.node_allocation.get(node_id, []))
-        return assign_devices(node, collect_in_use(live), tg)
+        if self.job.priority < PREEMPTION_PRIORITY_DELTA:
+            return []
+        already = {
+            a.id
+            for allocs in self.plan.node_preemptions.values()
+            for a in allocs
+        }
+        ids = select_victims(
+            ct,
+            self.snapshot,
+            self.job,
+            tg,
+            ask_vec,
+            row,
+            plan=self.plan,
+            exclude_ids=already,
+        )
+        return ids or []
+
+    def _assign_devices(self, tg, node_id):
+        from .device import assign_devices_for_plan
+
+        return assign_devices_for_plan(self.snapshot, self.plan, tg, node_id)
 
     def _record_failure(self, tg_name: str, metric: AllocMetric) -> None:
         existing = self.failed_tg_allocs.get(tg_name)

@@ -2,14 +2,24 @@
 
 Reference: nomad/server.go (endpoint registry :262-289, Raft wiring
 :105-109) + nomad/rpc.go ``forward()`` (non-leader servers transparently
-forward writes to the leader) + nomad/leader.go monitorLeadership
-(establish/revoke leader services on election).
+forward writes to the leader; requests tagged with a foreign region are
+forwarded to a server of that region first — forwardRegion) +
+nomad/leader.go monitorLeadership (establish/revoke leader services on
+election).
 
 Composition: Server (endpoints, broker, applier, watchers — leader-only
 services gated by raft callbacks) + RPCServer (transport) + RaftNode
 (replication). Clients and CLIs may talk to ANY server; reads answer
 locally (eventually-consistent default, like stale=true) and writes chase
 the leader.
+
+Federation: each region is its own Raft cluster; ``region_peers`` maps
+foreign region → server addresses (the reference discovers these via Serf
+WAN gossip, nomad/serf.go:295 — this build takes a static peer map, the
+same trade the core raft layer makes with its static peer set). A request
+whose ``region`` differs from the local one is handed to a foreign server
+verbatim (minus the tag) and the answer relayed — exactly the reference's
+forwardRegion hop (nomad/rpc.go).
 """
 
 from __future__ import annotations
@@ -50,11 +60,16 @@ class ClusterServer:
         rpc_server: RPCServer,
         data_dir: Optional[str] = None,
         server_config: Optional[ServerConfig] = None,
+        region_peers: Optional[Dict[str, list]] = None,
         **raft_overrides,
     ):
         self.node_id = node_id
         self.rpc = rpc_server
         cfg = server_config or ServerConfig()
+        self.region = cfg.region
+        # foreign region → [server addr, ...] (static federation map;
+        # the reference's Serf WAN gossip seam, serf.go:295)
+        self.region_peers: Dict[str, list] = dict(region_peers or {})
         cfg.data_dir = None  # durability lives in the RaftNode's log
         self.server = Server(cfg)
         self.raft = RaftNode(
@@ -118,6 +133,33 @@ class ClusterServer:
         def handler(args):
             kwargs = dict(args or {})
             hops = kwargs.pop("_hops", 0)
+            # cross-region hop first (nomad/rpc.go forwardRegion): a
+            # request tagged for a foreign region goes there verbatim;
+            # the receiving region then does its own leader chase
+            region = kwargs.pop("region", None)
+            if region is None and name == "register_job":
+                # Job.Register routes by the job's own region stanza
+                # (job_endpoint.go forwards to job.Region)
+                job = kwargs.get("job")
+                jr = getattr(job, "region", "") if job is not None else ""
+                if jr and jr != self.region:
+                    region = jr
+            if region and region != self.region:
+                addrs = self.region_peers.get(region)
+                if not addrs:
+                    raise ValueError(f"no path to region {region!r}")
+                if hops >= 3:
+                    raise RuntimeError("region forward loop")
+                kwargs["_hops"] = hops + 1
+                last_err: Exception | None = None
+                for addr in addrs:  # failover across the region's servers
+                    try:
+                        return self._forward(addr, f"Nomad.{name}", kwargs)
+                    except (ConnectionError, TimeoutError, OSError) as e:
+                        last_err = e
+                raise ConnectionError(
+                    f"region {region!r} unreachable: {last_err}"
+                )
             try:
                 return fn(**kwargs)
             except NotLeaderError as e:

@@ -1,0 +1,154 @@
+"""Client lifecycle: heartbeatstop (stop_after_client_disconnect) and
+terminal-alloc GC — the two accepted-but-ignored knobs VERDICT r3 #7
+carried. Reference: client/heartbeatstop.go:11-40, client/gc.go."""
+
+import os
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.client.client import Client
+from nomad_tpu.server.server import Server, ServerConfig
+
+from test_client import wait_until
+
+
+def make_server():
+    srv = Server(ServerConfig(num_workers=1))
+    srv.establish_leadership()
+    return srv
+
+
+class FlakyRPC:
+    """Wraps the in-process client RPC; heartbeats can be cut off to
+    simulate a client↔server partition without stopping the servers."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.heartbeats_ok = True
+
+    def register_node(self, node):
+        return self.inner.register_node(node)
+
+    def heartbeat(self, node_id):
+        if not self.heartbeats_ok:
+            raise ConnectionError("induced partition")
+        return self.inner.heartbeat(node_id)
+
+    def pull_allocs(self, node_id, min_index, timeout):
+        return self.inner.pull_allocs(node_id, min_index, timeout)
+
+    def update_allocs(self, updates):
+        return self.inner.update_allocs(updates)
+
+
+class TestHeartbeatStop:
+    def test_alloc_stops_after_client_disconnect(self, tmp_path):
+        """client/heartbeatstop.go:11-40: a group with
+        stop_after_client_disconnect stops locally once server contact
+        has been lost longer than the threshold."""
+        srv = make_server()
+        rpc = FlakyRPC(srv.client_rpc())
+        client = Client(rpc, data_dir=str(tmp_path), heartbeat_interval=0.1)
+        client.start()
+        try:
+            job = mock.job()
+            job.task_groups[0].count = 1
+            job.task_groups[0].stop_after_client_disconnect_s = 0.5
+            t = job.task_groups[0].tasks[0]
+            t.driver = "mock_driver"
+            t.config = {"run_for": 60.0}
+            srv.register_job(job)
+            assert wait_until(
+                lambda: any(
+                    r.client_status() == "running"
+                    for r in client.runners.values()
+                )
+            ), "alloc never started"
+            runner = next(iter(client.runners.values()))
+            # cut the heartbeat path only
+            rpc.heartbeats_ok = False
+            assert wait_until(
+                lambda: all(
+                    s.state == "dead" for s in runner.task_states.values()
+                ),
+                timeout=10,
+            ), "alloc not stopped after disconnect threshold"
+        finally:
+            client.shutdown()
+            srv.shutdown()
+
+    def test_alloc_without_knob_survives_disconnect(self, tmp_path):
+        srv = make_server()
+        rpc = FlakyRPC(srv.client_rpc())
+        client = Client(rpc, data_dir=str(tmp_path), heartbeat_interval=0.1)
+        client.start()
+        try:
+            job = mock.job()
+            job.task_groups[0].count = 1
+            t = job.task_groups[0].tasks[0]
+            t.driver = "mock_driver"
+            t.config = {"run_for": 60.0}
+            srv.register_job(job)
+            assert wait_until(
+                lambda: any(
+                    r.client_status() == "running"
+                    for r in client.runners.values()
+                )
+            )
+            runner = next(iter(client.runners.values()))
+            rpc.heartbeats_ok = False
+            time.sleep(1.0)  # well past any sub-second threshold
+            assert any(
+                s.state == "running" for s in runner.task_states.values()
+            ), "alloc without the knob must keep running through a partition"
+        finally:
+            client.shutdown()
+            srv.shutdown()
+
+
+class TestClientGC:
+    def test_terminal_alloc_dirs_reclaimed(self, tmp_path):
+        """client/gc.go: terminal alloc dirs beyond the retention bound
+        are destroyed, oldest first, and their runners dropped."""
+        srv = make_server()
+        client = Client(
+            srv.client_rpc(), data_dir=str(tmp_path), heartbeat_interval=0.2
+        )
+        client.gc_max_terminal_allocs = 2
+        client.start()
+        try:
+            jobs = []
+            for i in range(4):
+                job = mock.batch_job()
+                job.id = f"gcjob-{i}"
+                job.task_groups[0].count = 1
+                t = job.task_groups[0].tasks[0]
+                t.driver = "mock_driver"
+                t.config = {"run_for": 0.05}
+                srv.register_job(job)
+                jobs.append(job)
+            assert wait_until(
+                lambda: sum(
+                    1 for r in client.runners.values() if r.is_terminal()
+                ) + (4 - len(client.runners)) >= 4,
+                timeout=15,
+            ), "batch allocs never completed"
+            # sweep must retain at most the bound
+            assert wait_until(
+                lambda: len(
+                    [r for r in client.runners.values() if r.is_terminal()]
+                )
+                <= 2,
+                timeout=10,
+            )
+            # reclaimed dirs are gone from disk
+            allocs_root = os.path.join(str(tmp_path), "allocs")
+            live_dirs = (
+                set(os.listdir(allocs_root))
+                if os.path.isdir(allocs_root)
+                else set()
+            )
+            assert len(live_dirs) <= 2 + 1  # bound (+1 for sweep race)
+        finally:
+            client.shutdown()
+            srv.shutdown()

@@ -1,0 +1,150 @@
+"""Multi-region federation skeleton: region-tagged RPC with cross-region
+forwarding between two in-process clusters (nomad/rpc.go forwardRegion;
+membership via a static region-peer map standing in for Serf WAN gossip,
+nomad/serf.go:295)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.rpc import RPCClient, RPCServer
+from nomad_tpu.server.cluster import ClusterServer
+from nomad_tpu.server.server import ServerConfig
+
+FAST = dict(
+    election_timeout_min=0.10,
+    election_timeout_max=0.25,
+    heartbeat_interval=0.04,
+)
+
+
+def wait_until(fn, timeout=10.0, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.02)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture
+def two_regions(tmp_path):
+    """Two single-server Raft clusters, regions east and west, federated
+    by a static region-peer map."""
+    rpcs = {r: RPCServer() for r in ("east", "west")}
+    for r in rpcs.values():
+        r.start()
+    region_peers = {
+        "east": [rpcs["east"].address],
+        "west": [rpcs["west"].address],
+    }
+    servers = {}
+    for region in ("east", "west"):
+        servers[region] = ClusterServer(
+            f"{region}-s0",
+            {f"{region}-s0": rpcs[region].address},
+            rpcs[region],
+            data_dir=str(tmp_path / region),
+            server_config=ServerConfig(
+                num_workers=1, region=region, heartbeat_ttl=2.0
+            ),
+            region_peers={
+                k: v for k, v in region_peers.items() if k != region
+            },
+            **FAST,
+        )
+    for s in servers.values():
+        s.start()
+    for s in servers.values():
+        wait_until(lambda: s.raft.is_leader(), msg="leader election")
+    yield servers, rpcs
+    for s in servers.values():
+        s.shutdown()
+    for r in rpcs.values():
+        r.stop()
+
+
+class TestRegionForwarding:
+    def test_job_routed_to_its_region(self, two_regions):
+        """A job whose region stanza names the OTHER region, submitted to
+        the east server, must land in west's state store — the
+        forwardRegion hop (nomad/rpc.go)."""
+        servers, rpcs = two_regions
+        servers["west"].server.store.upsert_node(2, mock.node())
+        client = RPCClient(rpcs["east"].address)
+        try:
+            job = mock.job(region="west")
+            job.task_groups[0].count = 1
+            job.task_groups[0].tasks[0].driver = "mock_driver"
+            client.call("Nomad.register_job", {"job": job})
+            wait_until(
+                lambda: servers["west"].server.store.job_by_id(
+                    job.namespace, job.id
+                ),
+                msg="job in west",
+            )
+            assert (
+                servers["east"].server.store.job_by_id(job.namespace, job.id)
+                is None
+            )
+            # and west actually schedules it
+            wait_until(
+                lambda: servers["west"].server.store.allocs_by_job(
+                    job.namespace, job.id
+                ),
+                msg="west placement",
+            )
+        finally:
+            client.close()
+
+    def test_explicit_region_tag_forwards_any_write(self, two_regions):
+        """Any write RPC carrying region=<other> is forwarded verbatim."""
+        servers, rpcs = two_regions
+        client = RPCClient(rpcs["east"].address)
+        try:
+            node = mock.node()
+            client.call(
+                "Nomad.register_node", {"node": node, "region": "west"}
+            )
+            wait_until(
+                lambda: servers["west"].server.store.node_by_id(node.id),
+                msg="node in west",
+            )
+            assert servers["east"].server.store.node_by_id(node.id) is None
+        finally:
+            client.close()
+
+    def test_unknown_region_is_an_error(self, two_regions):
+        _servers, rpcs = two_regions
+        client = RPCClient(rpcs["east"].address)
+        try:
+            with pytest.raises(Exception):
+                client.call(
+                    "Nomad.register_node",
+                    {"node": mock.node(), "region": "mars"},
+                )
+        finally:
+            client.close()
+
+    def test_local_region_jobs_stay_local(self, two_regions):
+        servers, rpcs = two_regions
+        servers["east"].server.store.upsert_node(2, mock.node())
+        client = RPCClient(rpcs["east"].address)
+        try:
+            job = mock.job(region="east")
+            job.task_groups[0].tasks[0].driver = "mock_driver"
+            client.call("Nomad.register_job", {"job": job})
+            wait_until(
+                lambda: servers["east"].server.store.job_by_id(
+                    job.namespace, job.id
+                ),
+                msg="job in east",
+            )
+            assert (
+                servers["west"].server.store.job_by_id(job.namespace, job.id)
+                is None
+            )
+        finally:
+            client.close()

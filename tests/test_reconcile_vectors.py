@@ -1,0 +1,211 @@
+"""Reconciler parity vectors derived from scheduler/reconcile_test.go —
+the place/stop/inplace/destructive matrix with per-group DesiredUpdates
+counts, asserted against this build's reconcile() with the same mock-job
+fixtures (mock.job() mirrors mock.Job(): one group, count 10).
+
+The reference injects the inplace-vs-destructive verdict via
+allocUpdateFn{Ignore,Inplace,Destructive}; this build derives it from
+tasks_updated(old_job, new_job) — vectors emulate the injected verdict by
+bumping the job version without task changes (inplace) or with a task
+resource change (destructive).
+"""
+
+import copy
+import uuid
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.reconcile import reconcile
+from nomad_tpu.structs import Node, NODE_STATUS_DOWN
+
+
+def make_allocs(job, n, node_ids=None, version=None, tg=None):
+    out = []
+    tg = tg or job.task_groups[0].name
+    for i in range(n):
+        a = mock.alloc(job)
+        a.node_id = node_ids[i] if node_ids else str(uuid.uuid4())
+        a.name = f"{job.id}.{tg}[{i}]"
+        a.task_group = tg
+        if version is not None:
+            a.job_version = version
+        out.append(a)
+    return out
+
+
+def counts_of(r, tg="web"):
+    return r.desired_tg_updates[tg]
+
+
+class TestPlacementMatrix:
+    def test_place_no_existing(self):
+        """reconcile_test.go:291 TestReconciler_Place_NoExisting: count 10,
+        nothing running → place 10."""
+        job = mock.job()
+        r = reconcile(job, job.id, [], {})
+        assert len(r.place) == 10
+        assert not r.stop and not r.inplace_update and not r.destructive_update
+        assert counts_of(r)["place"] == 10
+
+    def test_place_existing(self):
+        """reconcile_test.go:317 TestReconciler_Place_Existing: 5 of 10
+        running → place 5, ignore 5."""
+        job = mock.job()
+        allocs = make_allocs(job, 5)
+        r = reconcile(job, job.id, allocs, {})
+        assert len(r.place) == 5
+        c = counts_of(r)
+        assert c["place"] == 5 and c["ignore"] == 5
+
+    def test_scale_down_partial(self):
+        """reconcile_test.go:355 TestReconciler_ScaleDown_Partial: 20
+        running, count 10 → stop 10, ignore 10."""
+        job = mock.job()
+        allocs = make_allocs(job, 20)
+        r = reconcile(job, job.id, allocs, {})
+        c = counts_of(r)
+        assert c["stop"] == 10 and c["ignore"] == 10 and c["place"] == 0
+
+    def test_scale_down_zero(self):
+        """reconcile_test.go:394 TestReconciler_ScaleDown_Zero: count 0,
+        20 running → stop 20."""
+        job = mock.job()
+        job.task_groups[0].count = 0
+        allocs = make_allocs(job, 20)
+        r = reconcile(job, job.id, allocs, {})
+        assert counts_of(r)["stop"] == 20
+        assert len(r.stop) == 20
+
+
+class TestUpdateMatrix:
+    def _versioned(self, destructive: bool, n=10, count=None):
+        """Existing allocs at version 0, job bumped to version 1; the
+        task diff decides inplace vs destructive."""
+        old = mock.job()
+        new = copy.deepcopy(old)
+        new.version = 1
+        if destructive:
+            new.task_groups[0].tasks[0].resources.cpu += 256
+        if count is not None:
+            new.task_groups[0].count = count
+        allocs = make_allocs(old, n, version=0)
+        for a in allocs:
+            a.job = old
+        return new, allocs
+
+    def test_inplace(self):
+        """reconcile_test.go:473 TestReconciler_Inplace: same tasks, new
+        version → 10 in-place updates, nothing destructive."""
+        job, allocs = self._versioned(destructive=False)
+        r = reconcile(job, job.id, allocs, {})
+        c = counts_of(r)
+        assert c["in_place_update"] == 10
+        assert c["destructive_update"] == 0 and c["place"] == 0
+
+    def test_inplace_scale_up(self):
+        """reconcile_test.go:510 TestReconciler_Inplace_ScaleUp: count 15
+        → inplace 10 + place 5."""
+        job, allocs = self._versioned(destructive=False, count=15)
+        r = reconcile(job, job.id, allocs, {})
+        c = counts_of(r)
+        assert c["in_place_update"] == 10 and c["place"] == 5
+
+    def test_inplace_scale_down(self):
+        """reconcile_test.go:551 TestReconciler_Inplace_ScaleDown: count 5
+        → stop 15, inplace 5."""
+        job, allocs = self._versioned(destructive=False, n=20, count=5)
+        r = reconcile(job, job.id, allocs, {})
+        c = counts_of(r)
+        assert c["stop"] == 15 and c["in_place_update"] == 5
+
+    def test_destructive(self):
+        """reconcile_test.go:659 TestReconciler_Destructive: task change →
+        10 destructive updates (no update stanza ⇒ no throttle, matching
+        mock.MaxParallelJob's MaxParallel=0 in :693)."""
+        job, allocs = self._versioned(destructive=True)
+        r = reconcile(job, job.id, allocs, {})
+        c = counts_of(r)
+        assert c["destructive_update"] == 10 and c["in_place_update"] == 0
+
+    def test_destructive_scale_up(self):
+        """reconcile_test.go:728 TestReconciler_Destructive_ScaleUp:
+        count 15 → destructive 10 + place 5."""
+        job, allocs = self._versioned(destructive=True, count=15)
+        r = reconcile(job, job.id, allocs, {})
+        c = counts_of(r)
+        assert c["destructive_update"] == 10 and c["place"] == 5
+
+    def test_destructive_scale_down(self):
+        """reconcile_test.go:768 TestReconciler_Destructive_ScaleDown:
+        20 existing, count 5 → destructive 5, stop 15."""
+        job, allocs = self._versioned(destructive=True, n=20, count=5)
+        r = reconcile(job, job.id, allocs, {})
+        c = counts_of(r)
+        assert c["stop"] == 15 and c["destructive_update"] == 5
+
+
+class TestNodeStateMatrix:
+    def test_lost_node(self):
+        """reconcile_test.go:807 TestReconciler_LostNode: 2 allocs on a
+        down node → stop 2 (lost), place 2, ignore 8."""
+        job = mock.job()
+        allocs = make_allocs(job, 10)
+        tainted = {}
+        for a in allocs[:2]:
+            tainted[a.node_id] = Node(id=a.node_id, status=NODE_STATUS_DOWN)
+        r = reconcile(job, job.id, allocs, tainted)
+        c = counts_of(r)
+        assert c["stop"] == 2 and c["place"] == 2 and c["ignore"] == 8
+
+    def test_drain_node_waits_for_migrate_mark(self):
+        """reconcile_test.go:955 TestReconciler_DrainNode: draining allocs
+        move only when the drainer marks DesiredTransition.Migrate
+        (reconcile_util.go filterByTainted)."""
+        job = mock.job()
+        allocs = make_allocs(job, 10)
+        n = mock.node()
+        n.id = allocs[0].node_id
+        from nomad_tpu.structs.node import DrainStrategy
+
+        n.drain = DrainStrategy()
+        tainted = {n.id: n}
+        # not yet marked: alloc waits
+        r = reconcile(job, job.id, allocs, tainted)
+        c = counts_of(r)
+        assert c["migrate"] == 0 and c["place"] == 0
+        # marked by the drainer: one migrate + replacement placement
+        allocs[0].desired_transition.migrate = True
+        r = reconcile(job, job.id, allocs, tainted)
+        c = counts_of(r)
+        assert c["migrate"] == 1 and c["place"] == 1 and c["ignore"] == 9
+
+    def test_removed_task_group(self):
+        """reconcile_test.go:1113 TestReconciler_RemovedTG: allocs of a
+        renamed/removed group stop; the new group fills fresh."""
+        job = mock.job()
+        allocs = make_allocs(job, 10)
+        job.task_groups[0].name = "other"
+        job.task_groups[0].tasks[0].name = "other"
+        r = reconcile(job, job.id, allocs, {})
+        assert counts_of(r, "web")["stop"] == 10
+        assert counts_of(r, "other")["place"] == 10
+
+    def test_job_stopped(self):
+        """reconcile_test.go:1157 TestReconciler_JobStopped."""
+        job = mock.job(stop=True)
+        allocs = make_allocs(job, 10)
+        r = reconcile(job, job.id, allocs, {})
+        assert len(r.stop) == 10 and not r.place
+
+    def test_multi_tg(self):
+        """reconcile_test.go:1281 TestReconciler_MultiTG: second group
+        empty → place 10 there, ignore the first group's 10."""
+        job = mock.job()
+        tg2 = copy.deepcopy(job.task_groups[0])
+        tg2.name = "api"
+        tg2.tasks[0].name = "api"
+        job.task_groups.append(tg2)
+        allocs = make_allocs(job, 10, tg="web")
+        r = reconcile(job, job.id, allocs, {})
+        assert counts_of(r, "api")["place"] == 10
+        assert counts_of(r, "web")["ignore"] == 10
+        assert len(r.place) == 10
