@@ -332,7 +332,121 @@ def bench_end_to_end(
         server.shutdown()
 
 
+def bench_grid() -> dict:
+    """The BenchmarkServiceScheduler grid (scheduler/benchmarks/
+    benchmarks_test.go:71-124): {1k, 5k, 10k} nodes × {10, 25, 50, 75}
+    racks × {300, 600, 900, 1200} allocs, with and without spread —
+    kernel-path timings per cell (one warm pass each; the e2e pipeline's
+    per-cell cost is covered by the headline config-3 run)."""
+    cells = []
+    for n_nodes in (1_000, 5_000, 10_000):
+        for racks in (10, 25, 50, 75):
+            for count in (300, 600, 900, 1200):
+                for spread in (False, True):
+                    if spread:
+                        r = bench_kernel_spread(
+                            n_nodes, n_lanes=4, count=count, racks=racks
+                        )
+                    else:
+                        r = bench_kernel(n_nodes, 4, count)
+                    cells.append(
+                        {
+                            "nodes": n_nodes,
+                            "racks": racks,
+                            "allocs_per_job": count,
+                            "spread": spread,
+                            "allocs_per_sec": r["allocs_per_sec"],
+                            "elapsed_s": r["elapsed_s"],
+                        }
+                    )
+    return {"cells": cells}
+
+
+def bench_replay(snapshot_path: str, n_jobs: int = 50, per_job: int = 100):
+    """Real-state replay (benchmarks_test.go:19-36
+    NOMAD_BENCHMARK_SNAPSHOT analog): bootstrap the server from a saved
+    raft snapshot and drive the standard job workload against whatever
+    nodes/allocs it contains."""
+    from nomad_tpu import mock
+    from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.state.snapshot import restore_snapshot
+
+    server = Server(ServerConfig(num_workers=2))
+    server._install_store(restore_snapshot(snapshot_path))
+    server.establish_leadership()
+    try:
+        snap = server.store.snapshot()
+        n_nodes = len(list(snap.nodes()))
+        t0 = time.perf_counter()
+        for j in range(n_jobs):
+            job = mock.job()
+            job.id = f"replay-{j}"
+            job.task_groups[0].count = per_job
+            server.register_job(job)
+        ok = server.wait_for_evals(timeout=600)
+        elapsed = time.perf_counter() - t0
+        placed = sum(
+            1
+            for a in server.store.allocs()
+            if a.job_id.startswith("replay-") and not a.terminal_status()
+        )
+        return {
+            "snapshot": snapshot_path,
+            "nodes_in_snapshot": n_nodes,
+            "drained": ok,
+            "placed": placed,
+            "total": n_jobs * per_job,
+            "elapsed_s": round(elapsed, 3),
+            "evals_per_sec": round(n_jobs / elapsed, 1),
+        }
+    finally:
+        server.shutdown()
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "grid":
+        fallback = _ensure_live_backend()
+        import jax
+
+        grid = bench_grid()
+        best = max(c["allocs_per_sec"] for c in grid["cells"])
+        print(
+            json.dumps(
+                {
+                    "metric": "benchmark grid (benchmarks_test.go:71-124 shape)",
+                    "value": best,
+                    "unit": "allocs/s (best cell)",
+                    "vs_baseline": round(best / (100_000 / 8.0), 3),
+                    "platform": jax.devices()[0].platform,
+                    "fallback": fallback,
+                    "detail": grid,
+                }
+            )
+        )
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "replay":
+        path = sys.argv[2] if len(sys.argv) > 2 else os.environ.get(
+            "NOMAD_TPU_BENCH_SNAPSHOT", ""
+        )
+        fallback = _ensure_live_backend()
+        import jax
+
+        r = bench_replay(path)
+        print(
+            json.dumps(
+                {
+                    "metric": f"replay of {path}",
+                    "value": r["evals_per_sec"],
+                    "unit": "evals/s",
+                    "vs_baseline": 0.0,
+                    "platform": jax.devices()[0].platform,
+                    "fallback": fallback,
+                    "detail": r,
+                }
+            )
+        )
+        return
+
     n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
     n_jobs = int(sys.argv[2]) if len(sys.argv) > 2 else 100
     count = int(sys.argv[3]) if len(sys.argv) > 3 else 1_000

@@ -24,6 +24,7 @@ class DevAgent:
         heartbeat_ttl: float = 5.0,
         node=None,
         host_volumes: Optional[dict] = None,
+        driver_mode: str = "inprocess",
     ):
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="nomad-tpu-dev-")
         self.server = Server(
@@ -34,6 +35,7 @@ class DevAgent:
             data_dir=self.data_dir,
             node=node,
             host_volumes=host_volumes,
+            driver_mode=driver_mode,
         )
 
     def start(self) -> None:

@@ -160,6 +160,16 @@ class HTTPAgent:
                 self.handle_snapshot_save,
             ),
             (re.compile(r"^/v1/agent/self$"), self.handle_agent_self),
+            (
+                # pprof surface (command/agent/http.go:331)
+                re.compile(r"^/v1/agent/pprof/(?P<kind>[^/]+)$"),
+                self.handle_pprof,
+            ),
+            (
+                # operator debug bundle (command/operator_debug.go:54)
+                re.compile(r"^/v1/operator/debug$"),
+                self.handle_operator_debug,
+            ),
             (re.compile(r"^/v1/status/leader$"), self.handle_leader),
             (re.compile(r"^/v1/metrics$"), self.handle_metrics),
             (re.compile(r"^/v1/acl/bootstrap$"), self.handle_acl_bootstrap),
@@ -1148,6 +1158,28 @@ class HTTPAgent:
         from ..utils.metrics import global_metrics
 
         return global_metrics.snapshot()
+
+    def handle_pprof(self, method, body, query, kind):
+        """/v1/agent/pprof/{goroutine,profile,heap} — thread dump,
+        sampling CPU profile, heap stats (utils/profile.py; reference
+        command/agent/http.go:331 gates these behind agent:read too)."""
+        self._enforce(query, "agent_read")
+        from ..utils import profile as prof
+
+        if kind == "goroutine":
+            return prof.thread_dump()
+        if kind == "profile":
+            seconds = min(float(query.get("seconds", 1.0)), 30.0)
+            return prof.sample_profile(seconds)
+        if kind == "heap":
+            return prof.heap_profile()
+        raise APIError(404, f"unknown pprof kind {kind!r}")
+
+    def handle_operator_debug(self, method, body, query):
+        self._enforce(query, "agent_read")
+        from ..utils.profile import debug_bundle
+
+        return debug_bundle(self.server)
 
     # -- ACL endpoints (nomad/acl_endpoint.go) -----------------------------
     def handle_acl_bootstrap(self, method, body, query):

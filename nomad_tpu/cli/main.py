@@ -70,15 +70,37 @@ def _parse_var_flags(var_flags) -> dict:
 
 # -- commands ---------------------------------------------------------------
 def cmd_agent(args) -> int:
-    """Run a dev agent (server+client+HTTP) in the foreground."""
+    """Run a dev agent (server+client+HTTP) in the foreground. HCL
+    config files (-config, command/agent/config.go) merge over defaults;
+    CLI flags override."""
     if not args.dev:
         return _fail("only -dev mode is supported in this build")
     from ..agent import DevAgent
+    from ..agent_config import AgentConfig, load_agent_config
     from ..api.http import HTTPAgent
 
-    agent = DevAgent(data_dir=args.data_dir or None)
+    cfg = AgentConfig()
+    if getattr(args, "config", None):
+        try:
+            cfg = load_agent_config(args.config)
+        except Exception as e:  # noqa: BLE001 — config errors are user-facing
+            return _fail(f"config: {e}")
+    agent = DevAgent(
+        data_dir=args.data_dir or cfg.data_dir or None,
+        num_workers=cfg.server.num_schedulers or 2,
+        heartbeat_ttl=cfg.server.heartbeat_ttl_s,
+        host_volumes=cfg.client.host_volumes or None,
+        driver_mode=cfg.client.driver_mode,
+    )
+    if cfg.client.gc_max_allocs:
+        agent.client.gc_max_terminal_allocs = cfg.client.gc_max_allocs
+    if cfg.telemetry.publish_allocation_metrics:
+        agent.client.publish_allocation_metrics = True
     agent.start()
-    host, _, port = args.bind.partition(":")
+    bind = args.bind if args.bind != "127.0.0.1:4646" else (
+        f"{cfg.bind_addr}:{cfg.http_port}"
+    )
+    host, _, port = bind.partition(":")
     http = HTTPAgent(
         agent.server, agent.client, host=host or "127.0.0.1",
         port=int(port or 4646),
@@ -434,6 +456,21 @@ def cmd_deployment_fail(args) -> int:
     return 0
 
 
+def cmd_operator_debug(args) -> int:
+    """`nomad operator debug` (command/operator_debug.go:54): capture a
+    support bundle (metrics, broker/worker/raft stats, thread dump) to a
+    file or stdout."""
+    c = _client(args)
+    bundle = c._request("GET", "/v1/operator/debug")
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(bundle, f, indent=2)
+        print(f"==> debug bundle written to {args.output}")
+    else:
+        print(json.dumps(bundle, indent=2))
+    return 0
+
+
 def cmd_operator_scheduler(args) -> int:
     c = _client(args)
     if args.algorithm:
@@ -550,6 +587,10 @@ def build_parser() -> argparse.ArgumentParser:
     agent.add_argument("-dev", action="store_true", dest="dev")
     agent.add_argument("--data-dir", default="")
     agent.add_argument("--bind", default="127.0.0.1:4646")
+    agent.add_argument(
+        "-config", action="append", dest="config", default=[],
+        help="HCL agent config file (repeatable; merged in order)",
+    )
     agent.set_defaults(fn=cmd_agent)
 
     job = sub.add_parser("job", help="job commands").add_subparsers(
@@ -656,6 +697,9 @@ def build_parser() -> argparse.ArgumentParser:
     sched = op.add_parser("scheduler")
     sched.add_argument("--algorithm", choices=["binpack", "spread"])
     sched.set_defaults(fn=cmd_operator_scheduler)
+    dbg = op.add_parser("debug", help="capture a support bundle")
+    dbg.add_argument("--output", "-o", default="")
+    dbg.set_defaults(fn=cmd_operator_debug)
 
     nsp = sub.add_parser("namespace", help="namespace commands").add_subparsers(
         dest="ns_cmd", required=True

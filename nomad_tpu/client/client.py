@@ -101,6 +101,9 @@ class Client:
         # only these are GC-eligible (destroying durable state before the
         # ack would let a post-partition reconcile re-run the alloc)
         self._acked_terminal: set[str] = set()
+        # telemetry.publish_allocation_metrics (command/agent/config.go
+        # Telemetry): per-alloc client-status counters on state changes
+        self.publish_allocation_metrics = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -281,6 +284,7 @@ class Client:
             if a is None:
                 runner.destroy()
                 self.state_db.delete_alloc(alloc_id)
+                self._acked_terminal.discard(alloc_id)
                 with self._lock:
                     self.runners.pop(alloc_id, None)
             elif a.desired_status in (ALLOC_DESIRED_STOP, "evict"):
@@ -314,6 +318,10 @@ class Client:
         }
         with self._lock:
             self._pending_updates[alloc.id] = upd
+        if self.publish_allocation_metrics:
+            from ..utils.metrics import global_metrics
+
+            global_metrics.incr(f"nomad.client.allocations.{status}")
         # keep the durable copy's status current so a restart doesn't
         # re-run an already-finished alloc
         self.state_db.put_alloc(upd)

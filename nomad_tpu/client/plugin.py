@@ -227,19 +227,26 @@ class PluginDriverClient(TaskDriver):
                 continue
             rid = msg.get("id")
             with self._lock:
-                evt = self._pending.pop(rid, None)
-                if evt is not None:
+                entry = self._pending.pop(rid, None)
+                if entry is not None:
                     self._results[rid] = msg
-            if evt is not None:
-                evt.set()
-        # plugin died: fail all in-flight requests
+            if entry is not None:
+                entry[0].set()
+        # this plugin died: fail only the requests issued to IT — a
+        # respawned plugin's in-flight requests must survive
         with self._lock:
-            for rid, evt in list(self._pending.items()):
+            dead = [
+                (rid, evt)
+                for rid, (evt, p) in self._pending.items()
+                if p is proc
+            ]
+            for rid, evt in dead:
+                self._pending.pop(rid, None)
                 self._results[rid] = {
                     "id": rid, "error": "driver plugin exited"
                 }
+            for _rid, evt in dead:
                 evt.set()
-            self._pending.clear()
 
     def _call(self, method: str, params: dict, timeout: Optional[float] = None):
         self._ensure_plugin()
@@ -247,7 +254,7 @@ class PluginDriverClient(TaskDriver):
             self._next_id += 1
             rid = self._next_id
             evt = threading.Event()
-            self._pending[rid] = evt
+            self._pending[rid] = (evt, self._proc)
             try:
                 self._proc.stdin.write(
                     json.dumps({"id": rid, "method": method, "params": params})
@@ -278,6 +285,10 @@ class PluginDriverClient(TaskDriver):
                     + "\n"
                 )
                 proc.stdin.flush()
+                # EOF releases serve_driver's stdin loop so the graceful
+                # path actually completes (the loop only re-checks the
+                # shutdown flag on its next line otherwise)
+                proc.stdin.close()
             except (BrokenPipeError, OSError):
                 pass
             try:

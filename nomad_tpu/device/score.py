@@ -176,6 +176,7 @@ def _score_planes(
     caps,  # f32[N] per-node device-slot caps
     algorithm_spread,  # bool[]
     max_j: int,
+    jitter=None,  # f32[N] tie-break noise (decorrelated batch passes)
 ):
     """The shared [N, J] candidate planes: numerator (sum of non-spread
     components), denominator (contributing-component count, spread
@@ -225,6 +226,13 @@ def _score_planes(
     resched = jnp.where(pen[:, None], -1.0, 0.0)
     aff_c = jnp.where(has_aff, aff[:, None], 0.0)
     num = fit_score + anti + resched + aff_c  # [N, J]
+    if jitter is not None:
+        # per-call deterministic tie-break noise (~1e-5 ≪ any meaningful
+        # score difference): the vector analog of the reference's
+        # per-worker node shuffle (stack.go:74-90) — without it every
+        # concurrent batch fills an empty homogeneous cluster in the
+        # same node order and the applier bounces the later plans
+        num = num + jitter[:, None]
     den = 1.0 + has_coll + pen[:, None] + jnp.where(has_aff, 1.0, 0.0)
     # slim [1]-shaped lane inputs leave den rank-deficient; the gather
     # paths index it per node, so materialize the broadcast
@@ -266,6 +274,7 @@ def place_closed_form_kernel(
     counts,  # i32[G]
     max_j: int,  # static: max instances of one group per node
     k: int,  # static: top-k width (≥ max count in batch + overflow)
+    jitter=None,  # f32[N] tie-break noise, shared across lanes
 ):
     """Returns (choices i32[G, k], scores f32[G, k]) in greedy order.
     Entries past a lane's feasible candidates are −1/−inf; entries in
@@ -278,7 +287,7 @@ def place_closed_form_kernel(
     def one_group(ask, elig, jc0, dt, pen, aff, has_aff, dh, caps, count):
         num, den, fits = _score_planes(
             capacity, used0, ask, elig, jc0, dt, pen, aff, has_aff, dh,
-            caps, algorithm_spread, max_j,
+            caps, algorithm_spread, max_j, jitter=jitter,
         )
         s_raw = jnp.where(fits, num / den, -jnp.inf)
         # Selection runs on the running-min clamp: it restores the prefix
@@ -384,6 +393,7 @@ def place_value_scan_kernel(
     counts,  # i32[G] placements to emit (incl. overflow slots)
     max_j: int,
     max_steps: int,
+    jitter=None,  # f32[N] tie-break noise
 ):
     """Greedy sequential placement with per-value count coupling.
 
@@ -405,7 +415,7 @@ def place_value_scan_kernel(
     ):
         num, den, fits = _score_planes(
             capacity, used0, ask, elig, jc0, dt, pen, aff, has_aff, dh,
-            caps, algorithm_spread, max_j,
+            caps, algorithm_spread, max_j, jitter=jitter,
         )
         n = num.shape[0]
         is_spread = (kinds == BLOCK_TARGET_SPREAD) | (kinds == BLOCK_EVEN_SPREAD)
@@ -498,6 +508,7 @@ def place_spread_chunked_kernel(
     max_j: int,
     chunk: int,
     n_chunks: int,
+    jitter=None,  # f32[N] tie-break noise
 ):
     """Chunked greedy placement for large spread-coupled groups.
 
@@ -530,7 +541,7 @@ def place_spread_chunked_kernel(
     ):
         num, den, fits = _score_planes(
             capacity, used0, ask, elig, jc0, dt, pen, aff, has_aff, dh,
-            caps, algorithm_spread, max_j,
+            caps, algorithm_spread, max_j, jitter=jitter,
         )
         n = num.shape[0]
         nb = vids.shape[0]
@@ -641,6 +652,7 @@ def place_spread_opv_kernel(
     max_j: int,
     k_seg: int,  # picks per step = min(CHUNK, V+1)
     n_chunks: int,
+    jitter=None,  # f32[N] tie-break noise
 ):
     """One-per-value chunked placement for even-mode spread groups.
 
@@ -668,7 +680,7 @@ def place_spread_opv_kernel(
     ):
         num, den, fits = _score_planes(
             capacity, used0, ask, elig, jc0, dt, pen, aff, has_aff, dh,
-            caps, algorithm_spread, max_j,
+            caps, algorithm_spread, max_j, jitter=jitter,
         )
         n = num.shape[0]
         nb = vids.shape[0]
@@ -958,6 +970,7 @@ class PlacementKernel:
         *,
         overflow: int = OVERFLOW_CANDIDATES,
         decorrelate: bool = False,
+        decorrelate_salt: int = 0,
     ) -> list[PlacementResult]:
         """``overflow`` = extra greedy candidates emitted per lane for
         conflict repair. ``decorrelate``: stripe each lane onto a disjoint
@@ -965,10 +978,18 @@ class PlacementKernel:
         same nodes — the vector analog of the reference's per-worker
         shuffle sampling (stack.go:74-90); repair re-scores any shortfall
         against the full node set, so partitioning is purely an
-        optimization."""
+        optimization. ``decorrelate_salt`` (worker id) permutes the
+        stripes so CONCURRENT WORKERS' batches collide at ~1/stripes
+        instead of stripe-for-stripe."""
         if not asks:
             return []
-        work = _decorrelate_lanes(cluster, asks) if decorrelate else asks
+        work = asks
+        jitter = None
+        if decorrelate:
+            work = _decorrelate_lanes(cluster, asks, salt=decorrelate_salt)
+            rows = np.arange(cluster.padded_n, dtype=np.int64)
+            h = (rows * 2654435761 + (decorrelate_salt + 1) * 40503) & 0xFFFFFFFF
+            jitter = ((h % 65536).astype(np.float32) / 65536.0) * 2e-5
         # routing: uncoupled groups → closed-form top-k; large
         # spread-coupled groups → chunked (one-per-value variant when an
         # even block is present); small / capped groups → exact scan
@@ -993,7 +1014,8 @@ class PlacementKernel:
         ):
             if idxs:
                 for i, r in zip(
-                    idxs, fn(cluster, [work[i] for i in idxs], overflow)
+                    idxs,
+                    fn(cluster, [work[i] for i in idxs], overflow, jitter),
                 ):
                     out[i] = r
         return out
@@ -1022,7 +1044,8 @@ class PlacementKernel:
         return max(16, -(-max_j // 16) * 16)
 
     def _place_closed_form(
-        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES
+        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES,
+        jitter=None,
     ) -> list[PlacementResult]:
         pn = cluster.padded_n
         max_count = max(a.count for a in asks)
@@ -1038,7 +1061,7 @@ class PlacementKernel:
             for i in range(0, len(asks), chunk):
                 out.extend(
                     self._place_closed_form(
-                        cluster, asks[i:i + chunk], overflow
+                        cluster, asks[i:i + chunk], overflow, jitter
                     )
                 )
             return out
@@ -1054,6 +1077,7 @@ class PlacementKernel:
                 algorithm_spread=jnp.asarray(self.algorithm_spread),
                 max_j=max_j,
                 k=k,
+                jitter=None if jitter is None else jnp.asarray(jitter),
             )
         )
         choices = fused[:, :k]  # writable copies: repair mutates rows
@@ -1069,7 +1093,8 @@ class PlacementKernel:
         ]
 
     def _place_scan_batch(
-        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES
+        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES,
+        jitter=None,
     ) -> list[PlacementResult]:
         from .flatten import pad_value_blocks
 
@@ -1097,11 +1122,13 @@ class PlacementKernel:
             algorithm_spread=jnp.asarray(self.algorithm_spread),
             max_j=max_j,
             max_steps=max_steps,
+            jitter=None if jitter is None else jnp.asarray(jitter),
         )
         return self._unpack_coupled(choices, scores, asks[:real_n], overflow)
 
     def _place_spread_chunked(
-        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES
+        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES,
+        jitter=None,
     ) -> list[PlacementResult]:
         from .flatten import pad_value_blocks
 
@@ -1131,11 +1158,13 @@ class PlacementKernel:
             max_j=max_j,
             chunk=CHUNK,
             n_chunks=n_chunks,
+            jitter=None if jitter is None else jnp.asarray(jitter),
         )
         return self._unpack_coupled(choices, scores, asks[:real_n], overflow)
 
     def _place_spread_opv(
-        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES
+        self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES,
+        jitter=None,
     ) -> list[PlacementResult]:
         from .flatten import pad_value_blocks
 
@@ -1194,6 +1223,7 @@ class PlacementKernel:
             max_j=max_j,
             k_seg=k_seg,
             n_chunks=n_chunks,
+            jitter=None if jitter is None else jnp.asarray(jitter),
         )
         return self._unpack_coupled(choices, scores, asks[:real_n], overflow)
 
@@ -1233,7 +1263,7 @@ class PlacementKernel:
         return out
 
 
-def _decorrelate_lanes(cluster, asks: list) -> list:
+def _decorrelate_lanes(cluster, asks: list, salt: int = 0) -> list:
     """Stripe each batch lane onto a disjoint subset of node rows
     (row % n_lanes == lane). Concurrent lanes scoring the same snapshot
     otherwise compute near-identical greedy sequences and pile onto the
@@ -1250,13 +1280,27 @@ def _decorrelate_lanes(cluster, asks: list) -> list:
     if n_lanes < 2:
         return asks
     pn = cluster.padded_n
-    stripe_of = np.arange(pn) % n_lanes
+    # stripes decorrelate lanes WITHIN one batch; concurrent workers are
+    # decorrelated by the score jitter (mod-l permutations of the row
+    # index only relabel the same congruence classes, so salting the
+    # stripe math cross-worker is a no-op — the salt instead rotates
+    # which lane gets which class, and seeds the jitter in place())
+    rows = np.arange(pn)
     out = []
     for i, a in enumerate(asks):
         if a.count <= 0:
             out.append(a)
             continue
-        elig = a.eligible & (stripe_of == i)
+        # widest stripe count that still leaves this lane comfortable
+        # headroom; when 1/n_lanes is too thin, lanes SHARE coarser
+        # stripes (conflicts only within a stripe group) instead of
+        # abandoning decorrelation entirely
+        total_elig = int(a.eligible.sum())
+        l_eff = min(n_lanes, max(1, total_elig // max(2 * a.count, 8)))
+        if l_eff < 2:
+            out.append(a)
+            continue
+        elig = a.eligible & ((rows % l_eff) == ((i + salt) % l_eff))
         ok = int(elig.sum()) >= max(2 * a.count, 8)
         if ok and a.blocks is not None:
             # the stripe must not silently amputate spread/cap values:

@@ -276,17 +276,22 @@ def preempt_for_devices(
                 freed += n
                 option.append((c, n))
                 if freed + free >= ask.count:
-                    options.append((did, option))
+                    options.append((option, free))
                     break
         else:
             if not options:
                 return None  # ask cannot be covered on this node
-            # minimal net unique-priority option (selectBestAllocs)
+            # minimal net unique-priority option (selectBestAllocs).
+            # Deviation: the reference filter counts preempted instances
+            # against the FULL ask (selectBestAllocs :558-604), evicting
+            # holders whose instances the device's already-free pool
+            # could cover; we count against (ask − free), which frees the
+            # same capacity with strictly fewer evictions.
             best, best_net = None, None
-            for _did, option in options:
+            for option, dev_free in options:
                 option.sort(key=lambda h: -h[1])  # instance count desc
                 taken, count, prios = [], 0, set()
-                need = ask.count
+                need = max(ask.count - dev_free, 0)
                 for c, n in option:
                     if count >= need:
                         break

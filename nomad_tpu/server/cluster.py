@@ -142,7 +142,10 @@ class ClusterServer:
                 # (job_endpoint.go forwards to job.Region)
                 job = kwargs.get("job")
                 jr = getattr(job, "region", "") if job is not None else ""
-                if jr and jr != self.region:
+                # "global" is the canonical default region stanza
+                # (structs.Job Canonicalize): it means "wherever
+                # submitted", never a forwarding target
+                if jr and jr != "global" and jr != self.region:
                     region = jr
             if region and region != self.region:
                 addrs = self.region_peers.get(region)

