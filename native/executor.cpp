@@ -1,0 +1,138 @@
+// executor — the task supervisor subprocess (C++ analog of the
+// reference's re-exec'd `nomad executor`, drivers/shared/executor/:
+// main.go:16-18 re-exec trick, executor.go process supervision).
+//
+// Why a separate native process: the supervisor OWNS the task child, so
+//  - the task survives the agent dying (the agent re-attaches to the
+//    EXECUTOR by pid+starttime, plugins/drivers/task_handle.go), and
+//  - the exit status is durable: the supervisor records it in a status
+//    file, so an agent restarted AFTER the task finished still observes
+//    the real exit code (the gap called out in client/drivers.py's
+//    recover(): without an owning process, exit codes read as 0).
+//
+// Usage:
+//   executor <task_dir> <stdout> <stderr> <status_file> <mem_mb> <grace_s> -- cmd [args...]
+//
+// Isolation applied to the child (the portable subset of the reference's
+// libcontainer executor): own session (setsid), RLIMIT_AS from the task
+// memory ask, no core dumps, bounded nproc. The parent forwards SIGTERM
+// to the child's process group with a 5 s grace before SIGKILL, then
+// exits with the child's exit code.
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/resource.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+static pid_t g_child = -1;
+static volatile sig_atomic_t g_killing = 0;
+static unsigned g_grace_s = 5;  // task kill_timeout, overridden by argv
+
+static void forward_term(int) {
+  if (g_child > 0) {
+    g_killing = 1;
+    kill(-g_child, SIGTERM);
+    alarm(g_grace_s);  // configured grace period, then hard kill
+  }
+}
+
+static void hard_kill(int) {
+  if (g_child > 0) kill(-g_child, SIGKILL);
+}
+
+static void write_status(const std::string &path, const std::string &line) {
+  // atomic replace so a reader never sees a torn write
+  std::string tmp = path + ".tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return;
+  ssize_t n = write(fd, line.c_str(), line.size());
+  (void)n;
+  fsync(fd);
+  close(fd);
+  rename(tmp.c_str(), path.c_str());
+}
+
+int main(int argc, char **argv) {
+  if (argc < 9) {
+    fprintf(stderr,
+            "usage: executor <task_dir> <stdout> <stderr> <status> <mem_mb> "
+            "<grace_s> -- cmd [args...]\n");
+    return 2;
+  }
+  std::string task_dir = argv[1];
+  std::string out_path = argv[2];
+  std::string err_path = argv[3];
+  std::string status_path = argv[4];
+  long mem_mb = atol(argv[5]);
+  long grace = atol(argv[6]);
+  if (grace > 0) g_grace_s = (unsigned)grace;
+  int cmd_at = -1;
+  for (int i = 7; i < argc; i++) {
+    if (strcmp(argv[i], "--") == 0) {
+      cmd_at = i + 1;
+      break;
+    }
+  }
+  if (cmd_at < 0 || cmd_at >= argc) {
+    fprintf(stderr, "executor: missing -- command\n");
+    return 2;
+  }
+
+  g_child = fork();
+  if (g_child < 0) {
+    perror("executor: fork");
+    return 2;
+  }
+  if (g_child == 0) {
+    // --- child: isolate, redirect, exec -------------------------------
+    setsid();
+    struct rlimit rl;
+    rl.rlim_cur = rl.rlim_max = (rlim_t)(mem_mb + 512) * 1024 * 1024;
+    setrlimit(RLIMIT_AS, &rl);
+    rl.rlim_cur = rl.rlim_max = 0;
+    setrlimit(RLIMIT_CORE, &rl);
+    rl.rlim_cur = rl.rlim_max = 512;
+    setrlimit(RLIMIT_NPROC, &rl);
+
+    int ofd = open(out_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    int efd = open(err_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (ofd >= 0) dup2(ofd, 1);
+    if (efd >= 0) dup2(efd, 2);
+    if (ofd >= 0) close(ofd);
+    if (efd >= 0) close(efd);
+    if (chdir(task_dir.c_str()) != 0) _exit(127);
+    execvp(argv[cmd_at], &argv[cmd_at]);
+    dprintf(2, "executor: exec %s: %s\n", argv[cmd_at], strerror(errno));
+    _exit(127);
+  }
+
+  // --- parent: supervise ----------------------------------------------
+  signal(SIGTERM, forward_term);
+  signal(SIGINT, forward_term);
+  signal(SIGALRM, hard_kill);
+  write_status(status_path, "running " + std::to_string((long)g_child) + "\n");
+
+  int wstatus = 0;
+  pid_t r;
+  do {
+    r = waitpid(g_child, &wstatus, 0);
+  } while (r < 0 && errno == EINTR);
+
+  int code = 127;
+  if (r == g_child) {
+    if (WIFEXITED(wstatus)) code = WEXITSTATUS(wstatus);
+    else if (WIFSIGNALED(wstatus)) code = 128 + WTERMSIG(wstatus);
+  }
+  write_status(status_path, "exit " + std::to_string(code) + "\n");
+  return code;
+}

@@ -36,11 +36,16 @@ class AllocRunner:
         on_update: Optional[Callable[[Allocation, str, dict], None]] = None,
         restored_handles: Optional[dict] = None,
         on_handle: Optional[Callable] = None,
+        prev_watcher: Optional[Callable] = None,
     ):
         self.alloc = alloc
         self.drivers = drivers
         self.alloc_dir = os.path.join(data_dir, "allocs", alloc.id)
         self.on_update = on_update
+        # allocwatcher seam (client/allocwatcher): blocks until the
+        # previous allocation stops and returns its alloc dir for
+        # ephemeral-disk migration (None = remote/unknown previous)
+        self.prev_watcher = prev_watcher
         # task_name → recovered TaskHandle (client restart re-attach)
         self.restored_handles = restored_handles or {}
         self.on_handle = on_handle
@@ -57,6 +62,7 @@ class AllocRunner:
             self._report(ALLOC_CLIENT_FAILED, "unknown task group")
             return
         os.makedirs(self.alloc_dir, exist_ok=True)
+        self._migrate_previous(tg)
         env = {
             "NOMAD_ALLOC_ID": self.alloc.id,
             "NOMAD_ALLOC_NAME": self.alloc.name,
@@ -90,6 +96,33 @@ class AllocRunner:
         for tr in self.task_runners.values():
             tr.start()
         self._report(ALLOC_CLIENT_RUNNING, "tasks are running")
+
+    def _migrate_previous(self, tg) -> None:
+        """Previous-alloc data migration (client/allocwatcher +
+        migrate_hook): with ephemeral_disk.migrate/sticky, wait for the
+        previous allocation to stop, then carry its shared dir into this
+        alloc. The reference streams remote dirs over the node API; this
+        build migrates same-node dirs and degrades to wait-only for
+        remote previous allocs."""
+        prev = self.alloc.previous_allocation
+        ed = getattr(tg, "ephemeral_disk", None)
+        if not prev or self.prev_watcher is None or ed is None:
+            return
+        if not (ed.migrate or ed.sticky):
+            return
+        src_dir = self.prev_watcher(prev)
+        if not src_dir:
+            return
+        src_shared = os.path.join(src_dir, "shared")
+        dst_shared = os.path.join(self.alloc_dir, "shared")
+        try:
+            if os.path.isdir(src_shared):
+                shutil.copytree(src_shared, dst_shared, dirs_exist_ok=True)
+        except (OSError, shutil.Error):
+            # the previous dir can be GC'd/destroyed concurrently — a
+            # failed migration degrades to a fresh disk, never a stuck
+            # alloc (run() has no other guard above the task loop)
+            pass
 
     def wait(self, timeout: Optional[float] = None) -> None:
         for tr in self.task_runners.values():

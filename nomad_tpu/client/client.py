@@ -301,12 +301,30 @@ class Client:
                 a, self.drivers, self.data_dir,
                 on_update=self._on_alloc_update,
                 on_handle=self.state_db.put_handle,
+                prev_watcher=self._watch_previous_alloc,
             )
             with self._lock:
                 self.runners[alloc_id] = runner
             threading.Thread(
                 target=runner.run, name=f"alloc-{alloc_id[:8]}", daemon=True
             ).start()
+
+    def _watch_previous_alloc(self, prev_id: str, timeout: float = 60.0):
+        """allocwatcher (client/allocwatcher): block until the previous
+        allocation's local runner reaches a terminal state; returns its
+        alloc dir for migration. None ⇒ previous alloc is remote or
+        already reclaimed (the reference would pull the dir over the
+        node API; descoped to same-node migration)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline and not self._stop.is_set():
+            with self._lock:
+                runner = self.runners.get(prev_id)
+            if runner is None:
+                return None
+            if runner._destroyed or runner.is_terminal():
+                return runner.alloc_dir
+            time.sleep(0.05)
+        return None
 
     # -- status sync -------------------------------------------------------
     def _on_alloc_update(self, alloc: Allocation, status: str, task_states) -> None:

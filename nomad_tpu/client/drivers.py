@@ -28,6 +28,45 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_EXECUTOR_BIN = os.path.join(_NATIVE_DIR, "build", "executor")
+_executor_checked = False
+_executor_lock = threading.Lock()
+
+
+def native_executor() -> Optional[str]:
+    """Path to the C++ task supervisor (native/executor.cpp — the
+    drivers/shared/executor analog), built lazily like the WAL store
+    (nomad_tpu/native/wal.py _load, same serialized-build discipline: a
+    concurrent caller must never exec a half-linked binary). None when
+    the toolchain is unavailable (pure-Python isolation then applies)."""
+    global _executor_checked
+    src = os.path.join(_NATIVE_DIR, "executor.cpp")
+    with _executor_lock:
+        if not _executor_checked:
+            if os.path.exists(src) and (
+                not os.path.exists(_EXECUTOR_BIN)
+                or os.path.getmtime(_EXECUTOR_BIN) < os.path.getmtime(src)
+            ):
+                try:
+                    os.makedirs(os.path.dirname(_EXECUTOR_BIN), exist_ok=True)
+                    tmp = _EXECUTOR_BIN + ".tmp"
+                    subprocess.run(
+                        ["g++", "-O2", "-std=c++17", "-Wall", "-o", tmp, src],
+                        check=True,
+                        capture_output=True,
+                        timeout=120,
+                    )
+                    os.replace(tmp, _EXECUTOR_BIN)
+                except Exception:
+                    return None
+            _executor_checked = True
+        return _EXECUTOR_BIN if os.path.exists(_EXECUTOR_BIN) else None
+
+
 def _proc_start_time(pid: int):
     """Kernel start time (clock ticks since boot) from /proc — the
     identity that distinguishes a live task from a recycled PID."""
@@ -264,6 +303,12 @@ class ExecDriver(RawExecDriver):
         if res is not None and getattr(res, "memory_mb", 0):
             mem_mb = int(res.memory_mb)
 
+        supervisor = native_executor()
+        if supervisor:
+            return self._start_supervised(
+                supervisor, task, argv, env, task_dir, mem_mb
+            )
+
         def _isolate():
             # post-fork pre-exec: no imports, no locks (the agent is
             # multithreaded; only async-signal-safe-ish work is allowed)
@@ -304,6 +349,167 @@ class ExecDriver(RawExecDriver):
         h.meta["proc_start"] = _proc_start_time(proc.pid)
         self._procs[h.id] = proc
         return h
+
+    # -- native supervisor path (drivers/shared/executor analog) ----------
+    def _start_supervised(
+        self, supervisor, task, argv, env, task_dir, mem_mb
+    ) -> TaskHandle:
+        """Run through the C++ executor: it owns the task child, applies
+        the isolation, and records the exit status durably, so re-attach
+        after an agent restart observes real exit codes."""
+        status_file = os.path.join(task_dir, f"{task.name}.status")
+        # a prior run of the same task left its record at the same path;
+        # it must never be read as THIS run's status
+        try:
+            os.unlink(status_file)
+        except OSError:
+            pass
+        grace = int(getattr(task, "kill_timeout_s", 5.0) or 5.0)
+        try:
+            proc = subprocess.Popen(
+                [
+                    supervisor,
+                    task_dir,
+                    os.path.join(task_dir, f"{task.name}.stdout"),
+                    os.path.join(task_dir, f"{task.name}.stderr"),
+                    status_file,
+                    str(mem_mb),
+                    str(grace),
+                    "--",
+                ]
+                + argv,
+                cwd=task_dir,
+                env={
+                    "PATH": "/usr/local/bin:/usr/bin:/bin",
+                    "HOME": task_dir,
+                    "TMPDIR": task_dir,
+                    **env,
+                },
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                start_new_session=True,
+            )
+        except OSError as e:
+            raise DriverError(f"failed to exec supervisor: {e}") from e
+        h = TaskHandle(id=str(uuid.uuid4()), driver=self.name, pid=proc.pid)
+        h.meta["proc_start"] = _proc_start_time(proc.pid)
+        h.meta["status_file"] = status_file
+        h.meta["supervised"] = True
+        self._procs[h.id] = proc
+        return h
+
+    def _read_status(self, handle) -> Optional[int]:
+        """The supervisor's durable status record: 'running <pid>' or
+        'exit <code>'."""
+        try:
+            with open(handle.meta["status_file"]) as f:
+                word, _, val = f.read().strip().partition(" ")
+        except (OSError, KeyError):
+            return None
+        if word == "exit":
+            return int(val)
+        return None
+
+    def recover(self, handle: TaskHandle) -> bool:
+        if handle.meta.get("supervised"):
+            # supervisor alive → live re-attach; dead → the status file
+            # still tells us how the task ended (the durability the
+            # reference gets from its executor process, task_handle.go)
+            if super().recover(handle):
+                return True
+            code = self._read_status(handle)
+            if code is not None:
+                handle.state = TASK_STATE_DEAD
+                handle.exit_code = code
+                handle.completed_at = handle.completed_at or time.time()
+                handle.meta["recovered"] = True
+                return True
+            return False
+        return super().recover(handle)
+
+    def wait(self, handle, timeout=None):
+        if not handle.meta.get("supervised"):
+            return super().wait(handle, timeout)
+        if handle.state == TASK_STATE_DEAD:
+            return handle.exit_code
+        proc = self._procs.get(handle.id)
+        if proc is not None:
+            try:
+                code = proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                return None
+            # the supervisor exits with the child's code; prefer the
+            # status file (survives supervisor signals)
+            rec = self._read_status(handle)
+            code = rec if rec is not None else code
+            handle.state = TASK_STATE_DEAD
+            handle.exit_code = code
+            handle.completed_at = time.time()
+            return code
+        # re-attached: poll the status file while the supervisor lives
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            code = self._read_status(handle)
+            if code is not None:
+                handle.state = TASK_STATE_DEAD
+                handle.exit_code = code
+                handle.completed_at = time.time()
+                return code
+            try:
+                os.kill(handle.pid, 0)
+            except ProcessLookupError:
+                # the supervisor may have written its exit record in the
+                # window between the read above and this probe
+                code = self._read_status(handle)
+                handle.state = TASK_STATE_DEAD
+                handle.exit_code = (
+                    code if code is not None else (handle.exit_code or 0)
+                )
+                handle.completed_at = handle.completed_at or time.time()
+                return handle.exit_code
+            if deadline is not None and time.time() >= deadline:
+                return None
+            time.sleep(0.1)
+
+    def stop(self, handle, kill_timeout=5.0):
+        if not handle.meta.get("supervised"):
+            return super().stop(handle, kill_timeout)
+        if handle.state == TASK_STATE_DEAD:
+            return  # already terminal (e.g. recovered via status record)
+        proc = self._procs.get(handle.id)
+        if proc is None:
+            # re-attached: verify pid identity before signalling — the
+            # recorded pid may have been recycled by an unrelated process
+            want = handle.meta.get("proc_start")
+            if want is None or _proc_start_time(handle.pid) != want:
+                return
+        # SIGTERM the supervisor; it forwards to the task's process group
+        # with the configured grace period (executor.cpp forward_term)
+        try:
+            os.kill(handle.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        proc = self._procs.get(handle.id)
+        if proc is not None:
+            try:
+                proc.wait(timeout=kill_timeout + 6.0)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.kill(handle.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+        else:
+            deadline = time.time() + kill_timeout + 6.0
+            while time.time() < deadline:
+                # the durable status record is authoritative — the pid
+                # may linger as a zombie under another holder
+                if self._read_status(handle) is not None:
+                    return
+                try:
+                    os.kill(handle.pid, 0)
+                except ProcessLookupError:
+                    return
+                time.sleep(0.1)
 
 
 def builtin_drivers() -> dict[str, TaskDriver]:

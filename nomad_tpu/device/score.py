@@ -1031,7 +1031,9 @@ class PlacementKernel:
 
     def _max_j(self, cluster, asks: list) -> int:
         """J bound: most instances of one identical ask any node could
-        hold, bucketed to multiples of 16."""
+        hold, bucketed to powers of two — each distinct J is a separate
+        XLA program (~30 s compile over the tunnel), which dwarfs the
+        ≤2× padded plane work."""
         cap_max = np.asarray(cluster.capacity).max(axis=0)  # [D]
         max_j = 1
         for a in asks:
@@ -1041,7 +1043,7 @@ class PlacementKernel:
             else:
                 j = a.count
             max_j = max(max_j, min(j, a.count))
-        return max(16, -(-max_j // 16) * 16)
+        return max(16, _steps_bucket(max_j))
 
     def _place_closed_form(
         self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES,

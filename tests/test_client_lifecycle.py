@@ -106,6 +106,69 @@ class TestHeartbeatStop:
             srv.shutdown()
 
 
+class TestPrevAllocMigration:
+    def test_ephemeral_disk_migrates_on_destructive_update(self, tmp_path):
+        """client/allocwatcher + migrate_hook: a destructive update's
+        replacement alloc inherits the previous alloc's shared dir when
+        ephemeral_disk.migrate is set."""
+        srv = make_server()
+        client = Client(
+            srv.client_rpc(), data_dir=str(tmp_path), heartbeat_interval=0.2
+        )
+        client.start()
+        try:
+            job = mock.job()
+            job.task_groups[0].count = 1
+            job.task_groups[0].ephemeral_disk.migrate = True
+            t = job.task_groups[0].tasks[0]
+            t.driver = "raw_exec"
+            t.config = {
+                "command": "/bin/sh",
+                "args": ["-c", 'echo v1-data > "$NOMAD_ALLOC_DIR/state.txt"; sleep 60'],
+            }
+            srv.register_job(job)
+            assert wait_until(
+                lambda: any(
+                    os.path.exists(
+                        os.path.join(r.alloc_dir, "shared", "state.txt")
+                    )
+                    for r in client.runners.values()
+                ),
+                timeout=15,
+            ), "v1 never wrote its state file"
+            v1_ids = set(client.runners)
+
+            # destructive update: changed resources force replacement
+            import copy
+
+            job2 = copy.deepcopy(job)
+            job2.task_groups[0].tasks[0].resources.cpu += 100
+            job2.task_groups[0].tasks[0].config = {
+                "command": "/bin/sh",
+                "args": ["-c", 'sleep 60'],
+            }
+            srv.register_job(job2)
+            assert wait_until(
+                lambda: any(
+                    rid not in v1_ids
+                    and r.client_status() == "running"
+                    for rid, r in client.runners.items()
+                ),
+                timeout=20,
+            ), "replacement alloc never ran"
+            repl = next(
+                r for rid, r in client.runners.items() if rid not in v1_ids
+            )
+            assert repl.alloc.previous_allocation in v1_ids
+            migrated = os.path.join(repl.alloc_dir, "shared", "state.txt")
+            assert wait_until(lambda: os.path.exists(migrated), timeout=10)
+            with open(migrated) as f:
+                assert f.read().strip() == "v1-data"
+        finally:
+            client.shutdown()
+            srv.shutdown()
+
+
 class TestClientGC:
     def test_terminal_alloc_dirs_reclaimed(self, tmp_path):
         """client/gc.go: terminal alloc dirs beyond the retention bound
