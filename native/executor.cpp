@@ -39,7 +39,9 @@ static volatile sig_atomic_t g_killing = 0;
 static unsigned g_grace_s = 5;  // task kill_timeout, overridden by argv
 
 static void forward_term(int) {
-  if (g_child > 0) {
+  if (g_child > 0 && !g_killing) {
+    // first TERM only: a stream of TERMs must not keep resetting the
+    // alarm and postponing the hard kill
     g_killing = 1;
     kill(-g_child, SIGTERM);
     alarm(g_grace_s);  // configured grace period, then hard kill

@@ -123,6 +123,7 @@ class Client:
             (self._watch_allocations, "alloc-watch"),
             (self._sync_loop, "alloc-sync"),
             (self._gc_loop, "gc"),
+            (self._driver_health_loop, "driver-health"),
         ):
             t = threading.Thread(target=fn, name=f"client-{name}", daemon=True)
             t.start()
@@ -216,6 +217,48 @@ class Client:
                 )
                 self._heartbeat_stopped.add(alloc_id)
                 runner.stop()
+
+    # -- driver health supervision (client/pluginmanager/drivermanager) ----
+    DRIVER_HEALTH_INTERVAL = 5.0
+
+    def _driver_health_loop(self) -> None:
+        """The driver-manager loop: periodically re-fingerprint every
+        driver and push node updates when health flips, so the scheduler
+        stops placing on drivers that died (and resumes when a plugin
+        recovers — PluginDriverClient respawns its subprocess lazily, so
+        a crashed plugin heals through this same probe)."""
+        push_pending = False
+        while not self._stop.is_set():
+            self._stop.wait(self.DRIVER_HEALTH_INTERVAL)
+            if self._stop.is_set():
+                return
+            changed = False
+            for name, drv in self.drivers.items():
+                try:
+                    healthy = bool(drv.fingerprint())
+                except Exception:
+                    healthy = False
+                if self.node.drivers.get(name) != healthy:
+                    self.node.drivers[name] = healthy
+                    self.node.attributes[f"driver.{name}"] = (
+                        "1" if healthy else "0"
+                    )
+                    changed = True
+                    log.info(
+                        "driver %s is now %s",
+                        name,
+                        "healthy" if healthy else "unhealthy",
+                    )
+            if changed or push_pending:
+                # push_pending: a failed push is retried next tick even
+                # though the local state already reflects the change
+                self.node.compute_class()
+                try:
+                    self.rpc.register_node(self.node)
+                    push_pending = False
+                except Exception:
+                    push_pending = True
+                    log.exception("node update after driver change failed")
 
     # -- terminal-alloc GC (client/gc.go) ----------------------------------
     def _gc_loop(self) -> None:

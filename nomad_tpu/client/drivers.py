@@ -62,6 +62,9 @@ def native_executor() -> Optional[str]:
                     )
                     os.replace(tmp, _EXECUTOR_BIN)
                 except Exception:
+                    # cache the failure: re-attempting a broken build on
+                    # every task start would stall starts behind the lock
+                    _executor_checked = True
                     return None
             _executor_checked = True
         return _EXECUTOR_BIN if os.path.exists(_EXECUTOR_BIN) else None
@@ -395,20 +398,23 @@ class ExecDriver(RawExecDriver):
         h.meta["proc_start"] = _proc_start_time(proc.pid)
         h.meta["status_file"] = status_file
         h.meta["supervised"] = True
+        h.meta["grace_s"] = float(grace)
         self._procs[h.id] = proc
         return h
 
-    def _read_status(self, handle) -> Optional[int]:
-        """The supervisor's durable status record: 'running <pid>' or
-        'exit <code>'."""
+    def _read_status_raw(self, handle) -> tuple[str, Optional[int]]:
+        """The supervisor's durable status record: ('running', child_pid)
+        or ('exit', code) or ('', None) when absent/unreadable."""
         try:
             with open(handle.meta["status_file"]) as f:
                 word, _, val = f.read().strip().partition(" ")
-        except (OSError, KeyError):
-            return None
-        if word == "exit":
-            return int(val)
-        return None
+            return word, int(val)
+        except (OSError, KeyError, ValueError):
+            return "", None
+
+    def _read_status(self, handle) -> Optional[int]:
+        word, val = self._read_status_raw(handle)
+        return val if word == "exit" else None
 
     def recover(self, handle: TaskHandle) -> bool:
         if handle.meta.get("supervised"):
@@ -417,13 +423,21 @@ class ExecDriver(RawExecDriver):
             # reference gets from its executor process, task_handle.go)
             if super().recover(handle):
                 return True
-            code = self._read_status(handle)
-            if code is not None:
+            word, val = self._read_status_raw(handle)
+            if word == "exit":
                 handle.state = TASK_STATE_DEAD
-                handle.exit_code = code
+                handle.exit_code = val
                 handle.completed_at = handle.completed_at or time.time()
                 handle.meta["recovered"] = True
                 return True
+            if word == "running" and val:
+                # supervisor died out from under a live task: reap the
+                # orphan before the restart policy launches a fresh copy
+                # (two concurrent runs of the workload otherwise)
+                try:
+                    os.killpg(val, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
             return False
         return super().recover(handle)
 
@@ -489,17 +503,15 @@ class ExecDriver(RawExecDriver):
             os.kill(handle.pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
             return
+        grace = max(kill_timeout, handle.meta.get("grace_s", 5.0)) + 6.0
         proc = self._procs.get(handle.id)
         if proc is not None:
             try:
-                proc.wait(timeout=kill_timeout + 6.0)
+                proc.wait(timeout=grace)
             except subprocess.TimeoutExpired:
-                try:
-                    os.kill(handle.pid, signal.SIGKILL)
-                except ProcessLookupError:
-                    pass
+                self._hard_kill_supervised(handle)
         else:
-            deadline = time.time() + kill_timeout + 6.0
+            deadline = time.time() + grace
             while time.time() < deadline:
                 # the durable status record is authoritative — the pid
                 # may linger as a zombie under another holder
@@ -510,6 +522,22 @@ class ExecDriver(RawExecDriver):
                 except ProcessLookupError:
                     return
                 time.sleep(0.1)
+            self._hard_kill_supervised(handle)
+
+    def _hard_kill_supervised(self, handle) -> None:
+        """Escalation targets the TASK's process group (from the status
+        record) — SIGKILLing only the supervisor would orphan a live
+        child in its own session and freeze the status at 'running'."""
+        word, val = self._read_status_raw(handle)
+        if word == "running" and val:
+            try:
+                os.killpg(val, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        try:
+            os.kill(handle.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
 
 def builtin_drivers() -> dict[str, TaskDriver]:

@@ -61,15 +61,28 @@ class ClusterServer:
         data_dir: Optional[str] = None,
         server_config: Optional[ServerConfig] = None,
         region_peers: Optional[Dict[str, list]] = None,
+        gossip_seeds: Optional[list] = None,
         **raft_overrides,
     ):
         self.node_id = node_id
         self.rpc = rpc_server
         cfg = server_config or ServerConfig()
         self.region = cfg.region
-        # foreign region → [server addr, ...] (static federation map;
-        # the reference's Serf WAN gossip seam, serf.go:295)
+        # foreign region → [server addr, ...]: static entries win, and
+        # the gossip member table (serf.go:295 WAN analog) fills in the
+        # rest when seeds are configured
         self.region_peers: Dict[str, list] = dict(region_peers or {})
+        self.gossip = None
+        if gossip_seeds is not None:
+            from .gossip import Gossip
+
+            self.gossip = Gossip(
+                name=node_id,
+                addr=rpc_server.address,
+                region=self.region,
+                rpc_server=rpc_server,
+                seeds=list(gossip_seeds),
+            )
         cfg.data_dir = None  # durability lives in the RaftNode's log
         self.server = Server(cfg)
         self.raft = RaftNode(
@@ -93,8 +106,12 @@ class ClusterServer:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.raft.start(self.rpc)
+        if self.gossip is not None:
+            self.gossip.start()
 
     def shutdown(self) -> None:
+        if self.gossip is not None:
+            self.gossip.stop()
         if self.server._leader:
             self.server.revoke_leadership()
         self.raft.shutdown()
@@ -149,6 +166,8 @@ class ClusterServer:
                     region = jr
             if region and region != self.region:
                 addrs = self.region_peers.get(region)
+                if not addrs and self.gossip is not None:
+                    addrs = self.gossip.region_peers().get(region)
                 if not addrs:
                     raise ValueError(f"no path to region {region!r}")
                 if hops >= 3:

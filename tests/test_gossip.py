@@ -1,0 +1,158 @@
+"""Gossip membership (server/gossip.py — the Serf/memberlist analog,
+nomad/serf.go:295): transitive discovery, failure detection with SWIM
+refutation, and gossip-derived cross-region federation."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.rpc import RPCClient, RPCServer
+from nomad_tpu.server.gossip import (
+    Gossip,
+    STATUS_ALIVE,
+    STATUS_FAILED,
+)
+
+
+def wait_until(fn, timeout=15.0, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def make_node(name, region="global", seeds=()):
+    rpc = RPCServer()
+    rpc.start()
+    g = Gossip(
+        name=name,
+        addr=rpc.address,
+        region=region,
+        rpc_server=rpc,
+        seeds=list(seeds),
+        interval=0.1,
+    )
+    g.start()
+    return rpc, g
+
+
+class TestGossip:
+    def test_transitive_discovery(self):
+        """A seeds B, B seeds C — everyone learns everyone through
+        push-pull anti-entropy, never having been configured with the
+        full list."""
+        rpc_a, a = make_node("a")
+        rpc_b, b = make_node("b", seeds=[rpc_a.address])
+        rpc_c, c = make_node("c", seeds=[rpc_b.address])
+        try:
+            for g in (a, b, c):
+                wait_until(
+                    lambda g=g: {m.name for m in g.alive_members()}
+                    == {"a", "b", "c"},
+                    msg=f"{g.name} full membership",
+                )
+        finally:
+            for g in (a, b, c):
+                g.stop()
+            for r in (rpc_a, rpc_b, rpc_c):
+                r.stop()
+
+    def test_failure_detection_and_refutation(self):
+        rpc_a, a = make_node("a")
+        rpc_b, b = make_node("b", seeds=[rpc_a.address])
+        try:
+            wait_until(
+                lambda: len(a.alive_members()) == 2, msg="a sees b"
+            )
+            # kill b's transport: a must mark it failed after the probe
+            # threshold
+            b.stop()
+            rpc_b.stop()
+            wait_until(
+                lambda: any(
+                    m.name == "b" and m.status == STATUS_FAILED
+                    for m in a.members.values()
+                ),
+                timeout=30,
+                msg="b declared failed",
+            )
+            # refutation: a node hearing itself declared failed bumps its
+            # incarnation and comes back alive
+            a.merge(
+                [
+                    {
+                        "name": "a",
+                        "addr": a.addr,
+                        "region": "global",
+                        "status": STATUS_FAILED,
+                        "incarnation": a.members["a"].incarnation,
+                        "last_seen": time.time(),
+                    }
+                ]
+            )
+            me = a.members["a"]
+            assert me.status == STATUS_ALIVE
+        finally:
+            a.stop()
+            rpc_a.stop()
+
+    def test_region_discovery_drives_forwarding(self, tmp_path):
+        """Two single-server clusters in different regions with NO static
+        region_peers: gossip discovery alone routes a west-region job
+        submitted to the east server (serf.go WAN federation role)."""
+        from nomad_tpu.server.cluster import ClusterServer
+        from nomad_tpu.server.server import ServerConfig
+
+        FAST = dict(
+            election_timeout_min=0.10,
+            election_timeout_max=0.25,
+            heartbeat_interval=0.04,
+        )
+        rpcs = {r: RPCServer() for r in ("east", "west")}
+        for r in rpcs.values():
+            r.start()
+        servers = {}
+        for region in ("east", "west"):
+            seeds = (
+                [rpcs["east"].address] if region == "west" else []
+            )
+            servers[region] = ClusterServer(
+                f"{region}-s0",
+                {f"{region}-s0": rpcs[region].address},
+                rpcs[region],
+                data_dir=str(tmp_path / region),
+                server_config=ServerConfig(num_workers=1, region=region),
+                gossip_seeds=seeds,
+                **FAST,
+            )
+        for s in servers.values():
+            s.start()
+        client = RPCClient(rpcs["east"].address)
+        try:
+            for s in servers.values():
+                wait_until(lambda s=s: s.raft.is_leader(), msg="leader")
+            wait_until(
+                lambda: "west" in servers["east"].gossip.region_peers(),
+                msg="east discovers west via gossip",
+            )
+            servers["west"].server.store.upsert_node(2, mock.node())
+            job = mock.job(region="west")
+            job.task_groups[0].count = 1
+            job.task_groups[0].tasks[0].driver = "mock_driver"
+            client.call("Nomad.register_job", {"job": job})
+            wait_until(
+                lambda: servers["west"].server.store.job_by_id(
+                    job.namespace, job.id
+                ),
+                msg="job landed in west",
+            )
+        finally:
+            client.close()
+            for s in servers.values():
+                s.shutdown()
+            for r in rpcs.values():
+                r.stop()
