@@ -52,6 +52,30 @@ static void hard_kill(int) {
   if (g_child > 0) kill(-g_child, SIGKILL);
 }
 
+static long proc_start_time(pid_t pid) {
+  // kernel start time (clock ticks since boot), /proc/<pid>/stat field 22
+  // — the identity that tells a live task from a recycled pid
+  char p[64];
+  snprintf(p, sizeof p, "/proc/%d/stat", (int)pid);
+  FILE *f = fopen(p, "r");
+  if (!f) return 0;
+  char buf[4096];
+  size_t n = fread(buf, 1, sizeof buf - 1, f);
+  fclose(f);
+  buf[n] = 0;
+  char *paren = strrchr(buf, ')');
+  if (!paren) return 0;
+  long v = 0;
+  int fieldno = 2;
+  for (char *tok = strtok(paren + 1, " "); tok; tok = strtok(nullptr, " ")) {
+    if (++fieldno == 22) {
+      v = atol(tok);
+      break;
+    }
+  }
+  return v;
+}
+
 static void write_status(const std::string &path, const std::string &line) {
   // atomic replace so a reader never sees a torn write
   std::string tmp = path + ".tmp";
@@ -122,7 +146,9 @@ int main(int argc, char **argv) {
   signal(SIGTERM, forward_term);
   signal(SIGINT, forward_term);
   signal(SIGALRM, hard_kill);
-  write_status(status_path, "running " + std::to_string((long)g_child) + "\n");
+  write_status(status_path, "running " + std::to_string((long)g_child) +
+                                " " + std::to_string(proc_start_time(g_child)) +
+                                "\n");
 
   int wstatus = 0;
   pid_t r;

@@ -266,8 +266,24 @@ class Client:
             self._stop.wait(GC_INTERVAL)
             try:
                 self.gc_sweep()
+                self.logmon_sweep()
             except Exception:
                 log.exception("alloc GC sweep failed")
+
+    def logmon_sweep(self) -> int:
+        """Rotate oversized task logs (client/logmon's retention role —
+        LogConfig MaxFiles × MaxFileSizeMB, copy-truncate). Serialized:
+        two concurrent sweepers would re-rotate a just-truncated file and
+        clobber the archived copy with an empty one."""
+        from .logmon import sweep_alloc
+
+        lock = getattr(self, "_logmon_lock", None)
+        if lock is None:
+            lock = self._logmon_lock = threading.Lock()
+        with self._lock:
+            runners = list(self.runners.values())
+        with lock:
+            return sum(sweep_alloc(r) for r in runners if not r._destroyed)
 
     def gc_sweep(self) -> None:
         """Reclaim the oldest terminal alloc dirs beyond the retention

@@ -402,18 +402,22 @@ class ExecDriver(RawExecDriver):
         self._procs[h.id] = proc
         return h
 
-    def _read_status_raw(self, handle) -> tuple[str, Optional[int]]:
-        """The supervisor's durable status record: ('running', child_pid)
-        or ('exit', code) or ('', None) when absent/unreadable."""
+    def _read_status_raw(self, handle) -> tuple[str, Optional[int], Optional[int]]:
+        """The supervisor's durable status record:
+        ('running', child_pid, child_start_ticks) or ('exit', code, None)
+        or ('', None, None) when absent/unreadable."""
         try:
             with open(handle.meta["status_file"]) as f:
-                word, _, val = f.read().strip().partition(" ")
-            return word, int(val)
-        except (OSError, KeyError, ValueError):
-            return "", None
+                parts = f.read().strip().split()
+            word = parts[0]
+            val = int(parts[1])
+            extra = int(parts[2]) if len(parts) > 2 else None
+            return word, val, extra
+        except (OSError, KeyError, ValueError, IndexError):
+            return "", None, None
 
     def _read_status(self, handle) -> Optional[int]:
-        word, val = self._read_status_raw(handle)
+        word, val, _ = self._read_status_raw(handle)
         return val if word == "exit" else None
 
     def recover(self, handle: TaskHandle) -> bool:
@@ -423,7 +427,7 @@ class ExecDriver(RawExecDriver):
             # reference gets from its executor process, task_handle.go)
             if super().recover(handle):
                 return True
-            word, val = self._read_status_raw(handle)
+            word, val, start_ticks = self._read_status_raw(handle)
             if word == "exit":
                 handle.state = TASK_STATE_DEAD
                 handle.exit_code = val
@@ -433,11 +437,18 @@ class ExecDriver(RawExecDriver):
             if word == "running" and val:
                 # supervisor died out from under a live task: reap the
                 # orphan before the restart policy launches a fresh copy
-                # (two concurrent runs of the workload otherwise)
-                try:
-                    os.killpg(val, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    pass
+                # (two concurrent runs of the workload otherwise) — but
+                # ONLY if the pid still belongs to that task (a recycled
+                # pid must never be signalled; the supervisor recorded
+                # the child's kernel start time for exactly this check)
+                if (
+                    start_ticks is not None
+                    and _proc_start_time(val) == start_ticks
+                ):
+                    try:
+                        os.killpg(val, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
             return False
         return super().recover(handle)
 
@@ -528,8 +539,10 @@ class ExecDriver(RawExecDriver):
         """Escalation targets the TASK's process group (from the status
         record) — SIGKILLing only the supervisor would orphan a live
         child in its own session and freeze the status at 'running'."""
-        word, val = self._read_status_raw(handle)
-        if word == "running" and val:
+        word, val, start_ticks = self._read_status_raw(handle)
+        if word == "running" and val and (
+            start_ticks is None or _proc_start_time(val) == start_ticks
+        ):
             try:
                 os.killpg(val, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):

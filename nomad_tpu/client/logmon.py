@@ -1,0 +1,74 @@
+"""logmon — task log retention by copy-truncate rotation.
+
+Reference: client/logmon/ (the per-task log-shipper subprocess rotating
+FIFO-fed logs under structs.LogConfig: MaxFiles × MaxFileSizeMB, default
+10 × 10 MiB). This build's drivers redirect task stdio straight into
+files (no FIFO hop), so rotation is copy-truncate: when a stream file
+exceeds its cap, the suffixed history shifts (.0 newest … .N oldest,
+oldest dropped), the current content is copied to ``.0``, and the live
+file is truncated in place — the writer's file descriptor stays valid, no
+writer cooperation needed. The fs/logs HTTP endpoints keep serving the
+live file; history rides beside it in the task dir.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+
+log = logging.getLogger("nomad_tpu.logmon")
+
+
+def rotate_if_needed(path: str, max_files: int, max_file_size_mb: int) -> bool:
+    """Rotate one stream file when it exceeds its cap. Returns True when
+    a rotation happened."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return False
+    if size <= max_file_size_mb * 1024 * 1024:
+        return False
+    # MaxFiles counts TOTAL files including the live one (structs.LogConfig),
+    # so history slots = max_files − 1; max_files=1 ⇒ pure truncation
+    history = max(max_files - 1, 0)
+    try:
+        if history > 0:
+            # shift .(h-2) → .(h-1) …; os.replace overwrites, so the
+            # oldest slot is dropped by the first shift
+            for i in range(history - 2, -1, -1):
+                src = f"{path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{path}.{i + 1}")
+            # copy-truncate: the writing process keeps its fd
+            shutil.copyfile(path, f"{path}.0")
+        with open(path, "r+b") as f:
+            f.truncate(0)
+        return True
+    except OSError:
+        log.exception("log rotation failed for %s", path)
+        return False
+
+
+def sweep_alloc(runner) -> int:
+    """Rotate every task stream of one alloc runner per its task's
+    LogConfig. Returns rotations performed."""
+    alloc = runner.alloc
+    job = alloc.job
+    tg = job.lookup_task_group(alloc.task_group) if job else None
+    if tg is None:
+        return 0
+    n = 0
+    for task in tg.tasks:
+        lc = getattr(task, "log_config", None)
+        if lc is None:
+            continue
+        task_dir = os.path.join(runner.alloc_dir, task.name)
+        for stream in ("stdout", "stderr"):
+            if rotate_if_needed(
+                os.path.join(task_dir, f"{task.name}.{stream}"),
+                lc.max_files,
+                lc.max_file_size_mb,
+            ):
+                n += 1
+    return n

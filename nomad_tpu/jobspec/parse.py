@@ -276,6 +276,15 @@ def _parse_task(block: hcl.Block, ctx: hcl.EvalContext) -> Task:
         la = _attrs(lc.body, ctx)
         t.lifecycle_hook = str(la.get("hook", ""))
         t.lifecycle_sidecar = bool(la.get("sidecar", False))
+    logs = b.first("logs")
+    if logs is not None:
+        from ..structs.job import LogConfig
+
+        lga = _attrs(logs.body, ctx)
+        t.log_config = LogConfig(
+            max_files=int(lga.get("max_files", 10)),
+            max_file_size_mb=int(lga.get("max_file_size", 10)),
+        )
     for vm in b.blocks_of("volume_mount"):
         from ..structs.volumes import VolumeMount
 

@@ -114,6 +114,10 @@ class Gossip:
                 if cur is None or m.incarnation > cur.incarnation:
                     m.last_seen = time.time()
                     self.members[m.name] = m
+                    if m.status == STATUS_ALIVE:
+                        # revival resets the probe count — otherwise one
+                        # later transient timeout jumps straight to FAILED
+                        self._probe_failures.pop(m.addr, None)
                 elif m.incarnation == cur.incarnation:
                     # equal incarnation: suspicion/death rumors win
                     rank = {STATUS_ALIVE: 0, STATUS_SUSPECT: 1, STATUS_FAILED: 2}

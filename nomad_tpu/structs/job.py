@@ -162,6 +162,15 @@ class ParameterizedJobConfig:
 
 
 @dataclass(slots=True)
+class LogConfig:
+    """Per-task log retention (structs.LogConfig, DefaultLogConfig:
+    10 files × 10 MiB) — consumed by the client's logmon rotation."""
+
+    max_files: int = 10
+    max_file_size_mb: int = 10
+
+
+@dataclass(slots=True)
 class Task:
     """One process under a driver. Reference: structs.Task."""
 
@@ -181,6 +190,7 @@ class Task:
     artifacts: list[dict] = field(default_factory=list)
     templates: list[dict] = field(default_factory=list)
     kind: str = ""
+    log_config: LogConfig = field(default_factory=LogConfig)
     # volume name → structs.volumes.VolumeMount
     volume_mounts: list = field(default_factory=list)
 

@@ -215,3 +215,69 @@ class TestClientGC:
         finally:
             client.shutdown()
             srv.shutdown()
+
+
+class TestLogmonRotation:
+    def test_copy_truncate_rotation(self, tmp_path):
+        """client/logmon retention: a stream file over its cap rotates to
+        .0 (history shifting, oldest dropped) and the live file truncates
+        without the writer reopening."""
+        from nomad_tpu.client.logmon import rotate_if_needed
+
+        path = tmp_path / "t.stdout"
+        path.write_bytes(b"x" * (2 * 1024 * 1024))
+        assert rotate_if_needed(str(path), max_files=3, max_file_size_mb=1)
+        assert path.stat().st_size == 0
+        assert (tmp_path / "t.stdout.0").stat().st_size == 2 * 1024 * 1024
+        # MaxFiles counts the live file too: max_files=3 ⇒ 2 history
+        # slots; the oldest content (x) drops off on the third rotation
+        for marker in (b"a", b"b"):
+            path.write_bytes(marker * (2 * 1024 * 1024))
+            assert rotate_if_needed(str(path), 3, 1)
+        assert (tmp_path / "t.stdout.0").read_bytes()[:1] == b"b"
+        assert (tmp_path / "t.stdout.1").read_bytes()[:1] == b"a"
+        assert not (tmp_path / "t.stdout.2").exists()
+        # under the cap: no rotation
+        path.write_bytes(b"small")
+        assert not rotate_if_needed(str(path), 3, 1)
+        # max_files=1: no history at all — pure truncation
+        solo = tmp_path / "solo.stdout"
+        solo.write_bytes(b"y" * (2 * 1024 * 1024))
+        assert rotate_if_needed(str(solo), 1, 1)
+        assert solo.stat().st_size == 0
+        assert not (tmp_path / "solo.stdout.0").exists()
+
+    def test_live_task_log_rotation_end_to_end(self, tmp_path):
+        """A running task whose stdout crosses the cap keeps writing into
+        the truncated live file after the client's sweep rotates it."""
+        srv = make_server()
+        client = Client(
+            srv.client_rpc(), data_dir=str(tmp_path), heartbeat_interval=0.2
+        )
+        client.start()
+        try:
+            from nomad_tpu.structs.job import LogConfig
+
+            job = mock.job()
+            job.task_groups[0].count = 1
+            t = job.task_groups[0].tasks[0]
+            t.driver = "raw_exec"
+            t.log_config = LogConfig(max_files=2, max_file_size_mb=1)
+            # ~1.5 MiB burst, then keep the task alive
+            t.config = {
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    "yes 0123456789012345678901234567890123456789 | head -c 1600000; sleep 60",
+                ],
+            }
+            srv.register_job(job)
+            assert wait_until(
+                lambda: client.logmon_sweep() > 0, timeout=20
+            ), "rotation never triggered"
+            runner = next(iter(client.runners.values()))
+            rotated = os.path.join(runner.alloc_dir, "web", "web.stdout.0")
+            assert os.path.getsize(rotated) > 1024 * 1024
+        finally:
+            client.shutdown()
+            srv.shutdown()

@@ -143,6 +143,167 @@ class TestUpdateMatrix:
         assert c["stop"] == 15 and c["destructive_update"] == 5
 
 
+class TestCanaryMatrix:
+    """The canary/deployment slice of reconcile_test.go (canaryUpdate
+    fixture :22-29: Canary=2, MaxParallel=2)."""
+
+    def _canary_update(self, canary=2, max_parallel=2):
+        from nomad_tpu.structs.job import UpdateStrategy
+
+        return UpdateStrategy(canary=canary, max_parallel=max_parallel)
+
+    def _changed_job(self, n_allocs=10, canary=2, count=None):
+        old = mock.job()
+        old.task_groups[0].update = self._canary_update(canary=canary)
+        new = copy.deepcopy(old)
+        new.version = 1
+        new.task_groups[0].tasks[0].resources.cpu += 256  # destructive
+        if count is not None:
+            new.task_groups[0].count = count
+            old.task_groups[0].count = count
+        allocs = make_allocs(old, n_allocs, version=0)
+        for a in allocs:
+            a.job = old
+        return new, allocs
+
+    def test_new_canaries(self):
+        """reconcile_test.go:3292 TestReconciler_NewCanaries: a changed
+        job with a canary update places 2 canaries, ignores the 10 old
+        allocs, and requests a deployment with DesiredCanaries=2 /
+        DesiredTotal=10."""
+        job, allocs = self._changed_job()
+        r = reconcile(job, job.id, allocs, {})
+        c = counts_of(r)
+        assert c["place"] == 2 and c["ignore"] == 10
+        assert all(p.canary for p in r.place)
+        assert not r.destructive_update
+        ds = r.deployment_states["web"]
+        assert ds.desired_canaries == 2 and ds.desired_total == 10
+
+    def test_new_canaries_count_greater(self):
+        """reconcile_test.go:3338 TestReconciler_NewCanaries_CountGreater:
+        canary count above the group count still places every canary."""
+        job, allocs = self._changed_job(n_allocs=3, canary=7, count=3)
+        r = reconcile(job, job.id, allocs, {})
+        c = counts_of(r)
+        assert c["place"] == 7 and c["ignore"] == 3
+        ds = r.deployment_states["web"]
+        assert ds.desired_canaries == 7 and ds.desired_total == 3
+
+    def test_existing_canaries_not_duplicated(self):
+        """reconcile_test.go:3292-family: canaries already placed for
+        this version are not placed again (promotion pending)."""
+        from nomad_tpu.structs.deployment import (
+            Deployment,
+            DeploymentState,
+        )
+
+        job, allocs = self._changed_job()
+        canary = mock.alloc(job)
+        canary.job_version = 1
+        canary.canary = True
+        canary.task_group = "web"
+        canary.name = f"{job.id}.web[0]"
+        deployment = Deployment(
+            namespace=job.namespace,
+            job_id=job.id,
+            job_version=1,
+            status="running",
+            task_groups={
+                "web": DeploymentState(
+                    desired_canaries=2, desired_total=10
+                )
+            },
+        )
+        r = reconcile(
+            job, job.id, allocs + [canary], {}, deployment=deployment
+        )
+        c = counts_of(r)
+        assert c["place"] == 1  # only the second canary
+        assert all(p.canary for p in r.place)
+
+    def test_promoted_deployment_rolls_destructive(self):
+        """After promotion (DeploymentState.promoted), the rollout
+        switches from canaries to max_parallel-bounded destructive
+        updates (reconcile.go computeGroup rolling phase)."""
+        from nomad_tpu.structs.deployment import (
+            Deployment,
+            DeploymentState,
+        )
+
+        job, allocs = self._changed_job()
+        deployment = Deployment(
+            namespace=job.namespace,
+            job_id=job.id,
+            job_version=1,
+            status="running",
+            task_groups={
+                "web": DeploymentState(
+                    promoted=True, desired_canaries=2, desired_total=10
+                )
+            },
+        )
+        r = reconcile(job, job.id, allocs, {}, deployment=deployment)
+        c = counts_of(r)
+        # max_parallel=2 bounds the in-flight destructive wave
+        assert c["destructive_update"] == 2
+        assert c["ignore"] == 8
+
+    def test_failed_deployment_halts_rollout(self):
+        """reconcile_test.go:2844-family (PausedOrFailedDeployment): a
+        FAILED deployment for this version stops further replacements."""
+        from nomad_tpu.structs.deployment import (
+            Deployment,
+            DeploymentState,
+        )
+
+        job, allocs = self._changed_job()
+        deployment = Deployment(
+            namespace=job.namespace,
+            job_id=job.id,
+            job_version=1,
+            status="failed",
+            task_groups={"web": DeploymentState(desired_total=10)},
+        )
+        r = reconcile(job, job.id, allocs, {}, deployment=deployment)
+        c = counts_of(r)
+        assert c["place"] == 0 and c["destructive_update"] == 0
+        assert c["ignore"] == 10
+
+
+class TestRescheduleMatrix:
+    def test_dont_reschedule_previously_rescheduled(self):
+        """reconcile_test.go:2440 TestReconciler_DontReschedule_
+        PreviouslyRescheduled: a failed alloc whose replacement already
+        exists (next_allocation set) is ignored, not re-replaced."""
+        job = mock.job()
+        job.task_groups[0].count = 5
+        allocs = make_allocs(job, 5)
+        failed = mock.alloc(job)
+        failed.name = f"{job.id}.web[0]"
+        failed.client_status = "failed"
+        failed.desired_status = "run"
+        failed.next_allocation = allocs[0].id
+        r = reconcile(job, job.id, allocs + [failed], {})
+        c = counts_of(r)
+        assert c["place"] == 0
+        assert len(r.disconnect_followups) == 0
+
+    def test_failed_with_followup_eval_ignored(self):
+        """generic_sched.go:718-753: a failed alloc already linked to a
+        followup eval waits for it instead of re-placing now."""
+        job = mock.job()
+        job.task_groups[0].count = 5
+        allocs = make_allocs(job, 5)
+        failed = mock.alloc(job)
+        failed.name = f"{job.id}.web[0]"
+        failed.client_status = "failed"
+        failed.desired_status = "run"
+        failed.followup_eval_id = "eval-123"
+        r = reconcile(job, job.id, allocs + [failed], {})
+        assert counts_of(r)["place"] == 0
+
+
 class TestNodeStateMatrix:
     def test_lost_node(self):
         """reconcile_test.go:807 TestReconciler_LostNode: 2 allocs on a
