@@ -86,6 +86,7 @@ class Client:
         self.runners: dict[str, AllocRunner] = {}
         self._pending_updates: dict[str, Allocation] = {}
         self._lock = threading.Lock()
+        self._logmon_lock = threading.Lock()  # serializes log rotation
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._last_index = 0
@@ -277,12 +278,9 @@ class Client:
         clobber the archived copy with an empty one."""
         from .logmon import sweep_alloc
 
-        lock = getattr(self, "_logmon_lock", None)
-        if lock is None:
-            lock = self._logmon_lock = threading.Lock()
         with self._lock:
             runners = list(self.runners.values())
-        with lock:
+        with self._logmon_lock:
             return sum(sweep_alloc(r) for r in runners if not r._destroyed)
 
     def gc_sweep(self) -> None:

@@ -440,10 +440,13 @@ class ExecDriver(RawExecDriver):
                 # (two concurrent runs of the workload otherwise) — but
                 # ONLY if the pid still belongs to that task (a recycled
                 # pid must never be signalled; the supervisor recorded
-                # the child's kernel start time for exactly this check)
-                if (
-                    start_ticks is not None
-                    and _proc_start_time(val) == start_ticks
+                # the child's kernel start time for exactly this check —
+                # records without usable ticks fall back to a liveness
+                # check, accepting the small recycled-pid risk over a
+                # guaranteed dual-run of the workload)
+                now_ticks = _proc_start_time(val)
+                if now_ticks is not None and (
+                    not start_ticks or now_ticks == start_ticks
                 ):
                     try:
                         os.killpg(val, signal.SIGKILL)
@@ -541,7 +544,7 @@ class ExecDriver(RawExecDriver):
         child in its own session and freeze the status at 'running'."""
         word, val, start_ticks = self._read_status_raw(handle)
         if word == "running" and val and (
-            start_ticks is None or _proc_start_time(val) == start_ticks
+            not start_ticks or _proc_start_time(val) == start_ticks
         ):
             try:
                 os.killpg(val, signal.SIGKILL)

@@ -126,6 +126,15 @@ class ClientEndpoints:
                     continue
                 if not follow:
                     return
+                # logmon copy-truncate rotation shrinks the live file
+                # under us: a reader offset past the new EOF would read
+                # b'' forever — rewind on truncation
+                try:
+                    if os.fstat(f.fileno()).st_size < f.tell():
+                        f.seek(0)
+                        continue
+                except OSError:
+                    return
                 # stop following once the task is dead and drained
                 runner = self.client.runners.get(alloc_id)
                 tr = runner.task_runners.get(task) if runner else None
