@@ -31,8 +31,11 @@ SCHEDULER_TYPES = ["service", "batch", "system", "sysbatch", "_core"]
 # batch dimension of the placement kernel replaces the reference's
 # worker-per-core concurrency (nomad/config.go:468). Each eval still
 # submits its own plan; the serialized applier resolves conflicts exactly
-# as it does for the reference's parallel workers.
-EVAL_BATCH_SIZE = 16
+# as it does for the reference's parallel workers. Sized so a burst of
+# registrations drains in a handful of passes — each pass costs ~2 tunnel
+# round trips regardless of depth, and lane decorrelation + host repair
+# keep wide batches conflict-free.
+EVAL_BATCH_SIZE = 64
 
 
 class Worker:
