@@ -203,11 +203,9 @@ def bench_end_to_end(
     from nomad_tpu.structs import Affinity, Spread
     from nomad_tpu.utils.metrics import global_metrics
 
-    # ONE scheduling worker: the batch dimension of the device pass IS the
-    # concurrency (SURVEY §2.7 — it replaces worker-per-core); a second
-    # worker batching against the same snapshot double-books capacity and
-    # the applier bounces the later plans (measured: conflict_rate 0 → 0.46
-    # at 64-deep batches with two workers)
+    # worker 0 batches; any additional workers drain solo evals
+    # (worker.py EVAL_BATCH_SIZE note) — one worker keeps the bench's
+    # batch counters exactly reconcilable
     server = Server(ServerConfig(num_workers=1))
     server.establish_leadership()
     try:
@@ -242,13 +240,16 @@ def bench_end_to_end(
             ]
             return job
 
-        # warmup: compile both G buckets (1 and the 16-lane batched pass)
-        # for this cluster size before the clock starts
-        for w in range(8):
+        # warmup: compile the G buckets the measured run will hit (1 for
+        # stragglers and the full EVAL_BATCH_SIZE-deep batched pass) for
+        # this cluster size before the clock starts
+        from nomad_tpu.server.worker import EVAL_BATCH_SIZE
+
+        for w in range(EVAL_BATCH_SIZE + 1):
             warm = make_job(10_000_000 + w)
             warm.id = f"warmup-{w}"
             server.register_job(warm)
-        server.wait_for_evals(timeout=240)
+        server.wait_for_evals(timeout=600)
         global_metrics.reset()
 
         t0 = time.perf_counter()
@@ -267,7 +268,7 @@ def bench_end_to_end(
         invoke = snap["samples"].get("nomad.worker.invoke_scheduler", {})
         counters = snap["counters"]
         # per-eval counter, NOT the invoke_scheduler sample count: the
-        # batched pass emits ONE timer sample per 16-eval batch
+        # batched pass emits ONE timer sample per multi-eval batch
         evals = int(counters.get("nomad.worker.evals_processed", n_jobs))
         batch_completed = int(
             counters.get("nomad.worker.batch_evals_completed", 0)
@@ -376,7 +377,7 @@ def bench_replay(snapshot_path: str, n_jobs: int = 50, per_job: int = 100):
     from nomad_tpu.server import Server, ServerConfig
     from nomad_tpu.state.snapshot import restore_snapshot
 
-    server = Server(ServerConfig(num_workers=2))
+    server = Server(ServerConfig(num_workers=1))
     server._install_store(restore_snapshot(snapshot_path))
     server.establish_leadership()
     try:

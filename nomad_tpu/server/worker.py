@@ -35,6 +35,12 @@ SCHEDULER_TYPES = ["service", "batch", "system", "sysbatch", "_core"]
 # registrations drains in a handful of passes — each pass costs ~2 tunnel
 # round trips regardless of depth, and lane decorrelation + host repair
 # keep wide batches conflict-free.
+#
+# Only worker 0 runs the batched pass: two workers batching the same
+# snapshot double-book capacity and the applier bounces the later plans
+# (measured conflict_rate 0 → 0.46 at 64-deep with two batching
+# workers). The remaining workers drain evals one at a time, overlapping
+# host-side reconcile/flatten work with the batch worker's device pass.
 EVAL_BATCH_SIZE = 64
 
 
@@ -77,7 +83,9 @@ class Worker:
                 continue
             with metrics.timer("nomad.worker.dequeue_eval"):
                 batch = self.server.eval_broker.dequeue_many(
-                    self.schedulers, EVAL_BATCH_SIZE, timeout=0.2
+                    self.schedulers,
+                    EVAL_BATCH_SIZE if self.id == 0 else 1,
+                    timeout=0.2,
                 )
             if not batch:
                 continue
