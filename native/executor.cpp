@@ -114,6 +114,18 @@ int main(int argc, char **argv) {
     return 2;
   }
 
+  // Block stop signals across fork so a SIGTERM delivered before the
+  // handlers are registered is queued, not fatal: an unhandled TERM in
+  // that window would kill the supervisor with default disposition,
+  // orphaning the child session and freezing the status file at
+  // "running". The parent unblocks after sigaction; the child restores
+  // the mask before exec (exec preserves the signal mask).
+  sigset_t stop_set, prev_set;
+  sigemptyset(&stop_set);
+  sigaddset(&stop_set, SIGTERM);
+  sigaddset(&stop_set, SIGINT);
+  sigprocmask(SIG_BLOCK, &stop_set, &prev_set);
+
   g_child = fork();
   if (g_child < 0) {
     perror("executor: fork");
@@ -121,6 +133,7 @@ int main(int argc, char **argv) {
   }
   if (g_child == 0) {
     // --- child: isolate, redirect, exec -------------------------------
+    sigprocmask(SIG_SETMASK, &prev_set, nullptr);
     setsid();
     struct rlimit rl;
     rl.rlim_cur = rl.rlim_max = (rlim_t)(mem_mb + 512) * 1024 * 1024;
@@ -146,6 +159,8 @@ int main(int argc, char **argv) {
   signal(SIGTERM, forward_term);
   signal(SIGINT, forward_term);
   signal(SIGALRM, hard_kill);
+  // handlers live: deliver anything queued during the blocked window
+  sigprocmask(SIG_SETMASK, &prev_set, nullptr);
   write_status(status_path, "running " + std::to_string((long)g_child) +
                                 " " + std::to_string(proc_start_time(g_child)) +
                                 "\n");

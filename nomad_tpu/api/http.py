@@ -120,6 +120,18 @@ class HTTPAgent:
                 self.handle_scheduler_config,
             ),
             (
+                # raft inspection (command/operator_raft_list.go,
+                # nomad/operator_endpoint.go RaftGetConfiguration)
+                re.compile(r"^/v1/operator/raft/configuration$"),
+                self.handle_raft_configuration,
+            ),
+            (
+                # peer removal (command/operator_raft_remove.go,
+                # operator_endpoint.go RaftRemovePeerByID)
+                re.compile(r"^/v1/operator/raft/peer$"),
+                self.handle_raft_peer,
+            ),
+            (
                 re.compile(r"^/v1/job/(?P<job_id>[^/]+)/dispatch$"),
                 self.handle_job_dispatch,
             ),
@@ -760,6 +772,40 @@ class HTTPAgent:
             self.server.raft_apply(MsgType.SCHED_CONFIG, {"config": new_cfg})
             return {"updated": True}
         raise APIError(405, f"method {method} not allowed")
+
+    def handle_raft_configuration(self, method, body, query):
+        """GET /v1/operator/raft/configuration — the voting set
+        (operator_endpoint.go RaftGetConfiguration)."""
+        if method != "GET":
+            raise APIError(405, f"method {method} not allowed")
+        self._enforce(query, "operator_read")
+        raft = self.server.raft
+        leader = raft.leader_id()
+        servers = [
+            {
+                "id": pid,
+                "address": addr,
+                "leader": pid == leader,
+                "voter": True,
+            }
+            for pid, addr in sorted(raft.peers().items())
+        ]
+        return {"servers": servers, "index": self.server.store.latest_index}
+
+    def handle_raft_peer(self, method, body, query):
+        """DELETE /v1/operator/raft/peer?id=<node_id> — remove a peer from
+        the voting set (operator_endpoint.go RaftRemovePeerByID)."""
+        if method != "DELETE":
+            raise APIError(405, f"method {method} not allowed")
+        self._enforce(query, "operator_write")
+        pid = (query.get("id") or [""])[0]
+        if not pid:
+            raise APIError(400, "missing ?id=<node_id>")
+        try:
+            self.server.raft.remove_peer(pid)
+        except ValueError as e:
+            raise APIError(400, str(e))
+        return {"removed": pid}
 
     def handle_job_dispatch(self, method, body, query, job_id):
         if method not in ("POST", "PUT"):

@@ -471,6 +471,36 @@ def cmd_operator_debug(args) -> int:
     return 0
 
 
+def cmd_operator_raft_list(args) -> int:
+    """`nomad operator raft list-peers`
+    (command/operator_raft_list.go)."""
+    c = _client(args)
+    cfg = c._request("GET", "/v1/operator/raft/configuration")
+    rows = [("ID", "Address", "State", "Voter")]
+    for s in cfg.get("servers", []):
+        rows.append((
+            s["id"],
+            s["address"],
+            "leader" if s.get("leader") else "follower",
+            "true" if s.get("voter") else "false",
+        ))
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    return 0
+
+
+def cmd_operator_raft_remove(args) -> int:
+    """`nomad operator raft remove-peer -peer-id=<id>`
+    (command/operator_raft_remove.go)."""
+    c = _client(args)
+    c._request(
+        "DELETE", "/v1/operator/raft/peer", params={"id": args.peer_id}
+    )
+    print(f"==> removed raft peer {args.peer_id}")
+    return 0
+
+
 def cmd_operator_scheduler(args) -> int:
     c = _client(args)
     if args.algorithm:
@@ -700,6 +730,14 @@ def build_parser() -> argparse.ArgumentParser:
     dbg = op.add_parser("debug", help="capture a support bundle")
     dbg.add_argument("--output", "-o", default="")
     dbg.set_defaults(fn=cmd_operator_debug)
+    raft = op.add_parser("raft", help="raft operator commands").add_subparsers(
+        dest="raft_cmd", required=True
+    )
+    rlist = raft.add_parser("list-peers")
+    rlist.set_defaults(fn=cmd_operator_raft_list)
+    rrem = raft.add_parser("remove-peer")
+    rrem.add_argument("--peer-id", dest="peer_id", required=True)
+    rrem.set_defaults(fn=cmd_operator_raft_remove)
 
     nsp = sub.add_parser("namespace", help="namespace commands").add_subparsers(
         dest="ns_cmd", required=True

@@ -9,6 +9,15 @@ oldest dropped), the current content is copied to ``.0``, and the live
 file is truncated in place — the writer's file descriptor stays valid, no
 writer cooperation needed. The fs/logs HTTP endpoints keep serving the
 live file; history rides beside it in the task dir.
+
+Trade-off vs the reference's FIFO logmon: copy-truncate is not lossless.
+Bytes the task writes between the snapshot copy and the truncate are
+dropped. The window is shrunk by copying exactly the snapshot size
+(os.pread up to that offset) and, when the file grew during the copy,
+re-copying the tail before truncating to zero — but a write that lands
+between the final size check and ftruncate is still lost. The reference
+avoids this by owning the write path (a FIFO the logmon drains); that
+needs writer cooperation this build's direct-to-file drivers don't have.
 """
 
 from __future__ import annotations
@@ -40,8 +49,32 @@ def rotate_if_needed(path: str, max_files: int, max_file_size_mb: int) -> bool:
                 src = f"{path}.{i}"
                 if os.path.exists(src):
                     os.replace(src, f"{path}.{i + 1}")
-            # copy-truncate: the writing process keeps its fd
-            shutil.copyfile(path, f"{path}.0")
+            # copy-truncate with a minimized loss window: copy under one
+            # read fd, then re-copy any tail the writer appended during
+            # the copy, and only then truncate. A write landing between
+            # the final fstat and ftruncate is still lost (documented
+            # module-level trade-off vs the reference's FIFO logmon).
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                with open(f"{path}.0", "wb") as dst:
+                    copied = 0
+                    while True:
+                        chunk = os.pread(fd, 1 << 20, copied)
+                        if not chunk:
+                            break
+                        dst.write(chunk)
+                        copied += len(chunk)
+                    # tail grown during the copy loop's last read?
+                    end = os.fstat(fd).st_size
+                    while copied < end:
+                        chunk = os.pread(fd, 1 << 20, copied)
+                        if not chunk:
+                            break
+                        dst.write(chunk)
+                        copied += len(chunk)
+                        end = os.fstat(fd).st_size
+            finally:
+                os.close(fd)
         with open(path, "r+b") as f:
             f.truncate(0)
         return True

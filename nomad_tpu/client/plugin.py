@@ -31,9 +31,11 @@ from __future__ import annotations
 
 import json
 import os
+import select
 import subprocess
 import sys
 import threading
+import time
 from dataclasses import asdict
 from typing import Optional
 
@@ -41,6 +43,9 @@ from .drivers import DriverError, TaskDriver, TaskHandle
 
 PLUGIN_MAGIC = "NOMAD_TPU_DRIVER_V1"
 PROTO_VERSION = 1
+# a spawned plugin must write its handshake line within this window or be
+# killed (a hung plugin would otherwise block every driver call)
+HANDSHAKE_TIMEOUT_S = 10.0
 
 
 def _handle_to_wire(h: TaskHandle) -> dict:
@@ -183,6 +188,7 @@ class PluginDriverClient(TaskDriver):
         self._results: dict[int, dict] = {}
         self._next_id = 0
         self._fingerprint = False
+        self._handshake_rest = b""
 
     # -- plugin lifecycle --------------------------------------------------
     def _ensure_plugin(self) -> None:
@@ -197,7 +203,39 @@ class PluginDriverClient(TaskDriver):
                 text=True,
                 env={**os.environ, "PYTHONPATH": os.pathsep.join(sys.path)},
             )
-            line = self._proc.stdout.readline()
+            # bounded handshake: a plugin that spawns but hangs before
+            # completing its handshake LINE must not wedge every driver
+            # call behind self._lock — kill it and report unhealthy. The
+            # deadline covers partial lines too (a crashing child can
+            # flush a truncated banner with no newline), so read raw
+            # bytes under select until newline or deadline rather than
+            # readline() (which would block past the first byte).
+            deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
+            raw_fd = self._proc.stdout.fileno()
+            buf = b""
+            while b"\n" not in buf:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._proc.kill()
+                    self._proc.wait()
+                    raise DriverError(
+                        f"driver plugin {self.name!r} handshake timed "
+                        f"out after {HANDSHAKE_TIMEOUT_S}s"
+                    )
+                ready, _, _ = select.select([raw_fd], [], [], remaining)
+                if not ready:
+                    continue
+                chunk = os.read(raw_fd, 4096)
+                if not chunk:  # EOF before a full handshake line
+                    break
+                buf += chunk
+            line, _, rest = buf.partition(b"\n")
+            # hand any over-read bytes back ahead of the reader thread's
+            # stream (requests are dispatched only after the handshake,
+            # so over-read can only happen from a misbehaving plugin —
+            # push it through the same JSON-line parser for symmetry)
+            self._handshake_rest = rest
+            line = line.decode("utf-8", "replace")
             if not line.strip():
                 # plugin died before the handshake (import failure etc.)
                 self._proc.kill()
@@ -215,12 +253,23 @@ class PluginDriverClient(TaskDriver):
                 )
             self._fingerprint = bool(hs.get("fingerprint"))
             t = threading.Thread(
-                target=self._read_loop, args=(self._proc,), daemon=True
+                target=self._read_loop,
+                args=(self._proc, self._handshake_rest),
+                daemon=True,
             )
             t.start()
 
-    def _read_loop(self, proc: subprocess.Popen) -> None:
-        for line in proc.stdout:
+    def _read_loop(self, proc: subprocess.Popen, rest: bytes = b"") -> None:
+        import itertools
+
+        # bytes over-read past the handshake newline bypass the buffered
+        # stream — feed them through the same line parser first
+        head = (
+            [ln + "\n" for ln in rest.decode("utf-8", "replace").split("\n") if ln]
+            if rest
+            else []
+        )
+        for line in itertools.chain(head, proc.stdout):
             try:
                 msg = json.loads(line)
             except json.JSONDecodeError:

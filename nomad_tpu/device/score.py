@@ -1301,22 +1301,61 @@ def _decorrelate_lanes(cluster, asks: list, salt: int = 0) -> list:
     # stripe math cross-worker is a no-op — the salt instead rotates
     # which lane gets which class, and seeds the jitter in place())
     rows = np.arange(pn)
+    # Stripe on a HASHED row index, not the raw row: raw `rows % l_eff`
+    # interacts arithmetically with any attribute laid out periodically
+    # over rows (racks assigned round-robin: rack = row % n_racks). When
+    # gcd(l_eff, n_racks) > 1 each stripe reaches only n_racks/gcd of the
+    # rack values, the reachability guard below rejects every lane, and
+    # the whole batch falls back to the full node set — measured as a
+    # 34× repair blow-up at 64 lanes × 25 racks. A multiplicative hash
+    # de-correlates stripe membership from any row-periodic attribute, so
+    # each stripe samples all values ~uniformly.
+    row_hash = (rows.astype(np.uint64) * np.uint64(2654435761)) & np.uint64(
+        0xFFFFFFFF
+    )
+    free = np.asarray(cluster.capacity) - np.asarray(cluster.used)  # [pn, D]
     out = []
     for i, a in enumerate(asks):
         if a.count <= 0:
             out.append(a)
             continue
-        # widest stripe count that still leaves this lane comfortable
-        # headroom; when 1/n_lanes is too thin, lanes SHARE coarser
+        # Widest stripe count that still leaves this lane comfortable
+        # headroom, measured in feasible INSTANCE SLOTS (Σ per-node jmax),
+        # not node count — a node holds many instances of one ask, and
+        # sizing by nodes (the old 2×count heuristic) capped l_eff at
+        # ~N/(2·count), forcing lanes to share stripes and collide (the
+        # measured 11.7 s repair blow-up at 64 lanes). When even the
+        # slot-based 1/n_lanes stripe is too thin, lanes SHARE coarser
         # stripes (conflicts only within a stripe group) instead of
-        # abandoning decorrelation entirely
+        # abandoning decorrelation entirely.
+        pos = a.ask > 0
+        if pos.any():
+            jn = np.floor(
+                np.min(free[:, pos] / a.ask[pos], axis=1)
+            ).clip(min=0)
+        else:
+            jn = np.full(pn, float(a.count))
+        jn = np.where(a.eligible, jn, 0.0)
         total_elig = int(a.eligible.sum())
-        l_eff = min(n_lanes, max(1, total_elig // max(2 * a.count, 8)))
+        slots = float(jn.sum())
+        l_eff = min(
+            n_lanes,
+            max(1, min(
+                int(slots // max(4 * a.count, 1)), total_elig // 8
+            )),
+        )
         if l_eff < 2:
             out.append(a)
             continue
-        elig = a.eligible & ((rows % l_eff) == ((i + salt) % l_eff))
-        ok = int(elig.sum()) >= max(2 * a.count, 8)
+        in_stripe = (
+            (row_hash % np.uint64(l_eff)).astype(np.int64)
+            == ((i + salt) % l_eff)
+        )
+        elig = a.eligible & in_stripe
+        # the stripe must still hold 2× the lane's ask in feasible slots
+        ok = float(jn[in_stripe].sum()) >= 2 * a.count and int(
+            elig.sum()
+        ) >= 8
         if ok and a.blocks is not None:
             # the stripe must not silently amputate spread/cap values:
             # every value reachable from the full eligible set must stay
@@ -1423,6 +1462,7 @@ def repair_batch_conflicts(
     results: list,
     algorithm_spread: bool = False,
     fail_on_contention: bool = False,
+    lane_groups: Optional[list] = None,
 ) -> list[bool]:
     """Host-side optimistic-conflict resolution for one batched pass.
 
@@ -1445,12 +1485,28 @@ def repair_batch_conflicts(
     state, where preemption and retries apply. Intrinsically infeasible
     placements (caps exhausted, cluster full even alone) stay −1 with
     ok=True — they'd fail individually too, and become blocked evals.
+
+    ``lane_groups`` (optional, parallel to ``asks``) marks lanes that
+    belong to one EVAL (a multi-task-group eval spans several lanes and
+    the caller discards the whole eval when any lane fails): a contention
+    failure releases the overlay reservations of EVERY processed lane in
+    the group and skips its remaining lanes — sibling placements of a
+    discarded plan must not stay reserved against later lanes.
     """
     capacity = np.asarray(cluster.capacity)
     used0 = np.asarray(cluster.used)
     used = used0.copy()
     ok_lanes: list[bool] = []
-    for a, res in zip(asks, results):
+    # group id -> [(placed_on_node, ask), ...] commit journal for rollback
+    group_commits: dict = {}
+    failed_groups: set = set()
+    for lane_idx, (a, res) in enumerate(zip(asks, results)):
+        group = lane_groups[lane_idx] if lane_groups is not None else lane_idx
+        if group in failed_groups:
+            # a sibling lane of this eval already hit contention: the
+            # whole eval re-runs individually, so don't reserve anything
+            ok_lanes.append(False)
+            continue
         ok = True
         # within-lane placements per node (distinct_hosts, slot caps,
         # anti-affinity collisions all key off it)
@@ -1536,6 +1592,18 @@ def repair_batch_conflicts(
                 continue
             outcome = rescore(i)
             if outcome == "contention" and not fail_on_contention:
+                # this eval re-runs individually on fresh state — its plan
+                # is NOT submitted, so its already-committed placements
+                # must not stay reserved in the shared overlay (phantom
+                # reservations would cascade later lanes into serial
+                # fallbacks a fresh-state rerun would avoid). Release this
+                # lane AND every processed sibling lane of the same eval.
+                for r, m in placed_on_node.items():
+                    used[r] -= m * a.ask
+                for sib_placed, sib_ask in group_commits.get(group, ()):
+                    for r, m in sib_placed.items():
+                        used[r] -= m * sib_ask
+                failed_groups.add(group)
                 ok = False
                 break
             if outcome in ("intrinsic", "contention"):
@@ -1546,5 +1614,9 @@ def repair_batch_conflicts(
                 res.node_rows[i] = -1
                 res.scores[i] = -np.inf
                 dead = True
+        if ok and lane_groups is not None:
+            group_commits.setdefault(group, []).append(
+                (placed_on_node, a.ask)
+            )
         ok_lanes.append(ok)
     return ok_lanes

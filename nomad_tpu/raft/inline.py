@@ -42,6 +42,12 @@ class InlineRaft:
     def leader_id(self) -> Optional[str]:
         return "local"
 
+    def peers(self) -> dict:
+        return {"local": "local"}
+
+    def remove_peer(self, node_id: str, timeout: float = 10.0) -> None:
+        raise ValueError("single-server (dev) mode has no removable peers")
+
     def apply(self, mtype: int, payload: Optional[dict] = None,
               timeout: float = 10.0) -> Tuple[int, Any]:
         with self._lock:

@@ -7,9 +7,13 @@ entries, majority commitment, snapshot install for lagging followers),
 persisted in the native C++ WAL (term/vote in its KV, entries in the
 segmented log) and transported over nomad_tpu.rpc.
 
-Scope notes vs hashicorp/raft: static peer set per process lifetime
-(membership changes = restart with new config, the pre-autopilot
-operational model); pre-vote and leadership transfer are not implemented.
+Scope notes vs hashicorp/raft: peer ADDITION is static per process
+lifetime (join = restart with new config); peer REMOVAL is dynamic — a
+RAFT_REMOVE_PEER entry committed through the log (the single-server
+membership-change special case; hashicorp/raft RemoveServer), consumed by
+autopilot dead-server cleanup (nomad/autopilot.go) and `operator raft
+remove-peer` (command/operator_raft_remove.go). Pre-vote and leadership
+transfer are not implemented.
 """
 
 from __future__ import annotations
@@ -133,6 +137,25 @@ class RaftNode:
         self.state = FOLLOWER
         self.term = self._load_u64("term")
         self.voted_for = self._load_str("voted_for")
+        # Membership survives restart/compaction: a committed
+        # RAFT_REMOVE_PEER persists the REMOVED-PEER SET (and our own
+        # removed flag) in the node's durable KV. Without this, a restart
+        # would revert to the full peer set and a restarted removed
+        # server could campaign again — with enough reverted servers, two
+        # disjoint quorums (split brain). Persisting the removed SET (not
+        # the whole peer map) keeps the documented join-by-restart path
+        # working: new peers and address changes still flow from the
+        # static startup config; only removals are subtracted. Re-adding
+        # a removed server requires wiping its entry (fresh data-dir /
+        # operator action), the same contract as hashicorp/raft.
+        removed_blob = self.log.kv_get("removed_peers")
+        self._removed_peers: set = (
+            pickle.loads(removed_blob) if removed_blob else set()
+        )
+        for rid in self._removed_peers:
+            if rid != config.node_id:
+                self.config.peers.pop(rid, None)
+        self._removed_persisted = self.log.kv_get("removed") == b"1"
         self.leader: Optional[str] = None
         self.commit_index = 0
         self.last_applied = 0
@@ -155,6 +178,17 @@ class RaftNode:
         self._match_index: dict[str, int] = {}
         self._next_index: dict[str, int] = {}
         self._entries_since_snap = 0
+        # set when a committed membership change removed THIS server from
+        # the voting set: it stops starting elections (a removed server
+        # kicking off term churn is the classic disruption autopilot's
+        # dead-server cleanup exists to avoid)
+        self._removed = self._removed_persisted
+        # lame-duck replication: a peer removed from the config keeps
+        # receiving append_entries until it ACKS the removal entry (so a
+        # LIVE removed server learns it was removed instead of election-
+        # timing-out and disrupting the survivors) or the grace expires
+        # (a DEAD one can't ack). peer_id -> (removal_index, deadline).
+        self._lame_ducks: dict[str, tuple[int, float]] = {}
 
     # -- persistence helpers ----------------------------------------------
     def _load_u64(self, key: str) -> int:
@@ -172,6 +206,18 @@ class RaftNode:
     def _persist_snap_meta(self) -> None:
         self.log.kv_set("snap_index", self.snap_index.to_bytes(8, "little"))
         self.log.kv_set("snap_term", self.snap_term.to_bytes(8, "little"))
+
+    def _persist_membership_locked(self) -> None:
+        """Durable membership: the config change must survive restart and
+        log compaction (the removal entry itself can be compacted away).
+        Only the removed SET is persisted — additions and address changes
+        keep flowing from the static startup config."""
+        self.log.kv_set(
+            "removed_peers",
+            pickle.dumps(self._removed_peers, pickle.HIGHEST_PROTOCOL),
+        )
+        self.log.kv_set("removed", b"1" if self._removed else b"0")
+        self.log.sync()
 
     def _snap_path(self) -> str:
         return os.path.join(self.config.data_dir or "", "state.snap")
@@ -250,6 +296,27 @@ class RaftNode:
     def is_leader(self) -> bool:
         return self.state == LEADER
 
+    def peers(self) -> Dict[str, str]:
+        """Current voting configuration (node_id -> addr), self included."""
+        with self._mu:
+            return dict(self.config.peers)
+
+    def remove_peer(self, node_id: str, timeout: float = 10.0) -> None:
+        """Leader-only: commit a membership change removing ``node_id``
+        from the voting set (autopilot / operator raft remove-peer). The
+        change takes effect on each server as the entry applies; the
+        removed server stops participating (no elections, no votes)."""
+        from ..server.fsm import MsgType
+
+        with self._mu:
+            if node_id not in self.config.peers:
+                raise ValueError(f"unknown raft peer {node_id!r}")
+            if node_id == self.config.node_id:
+                raise ValueError("cannot remove the current leader; "
+                                 "transfer leadership first")
+        self.apply(MsgType.RAFT_REMOVE_PEER, {"node_id": node_id},
+                   timeout=timeout)
+
     def leader_id(self) -> Optional[str]:
         return self.leader
 
@@ -312,7 +379,7 @@ class RaftNode:
             with self._mu:
                 if self._stop.is_set():  # shutdown raced our wait: the log
                     return                # may already be closed
-                if self.state == LEADER:
+                if self.state == LEADER or self._removed:
                     continue
                 if time.monotonic() - self._last_contact < self._timeout:
                     continue
@@ -446,6 +513,17 @@ class RaftNode:
                     self.term != term
                 ):
                     return
+                if peer_id not in self.config.peers:
+                    duck = self._lame_ducks.get(peer_id)
+                    if duck is None or time.monotonic() > duck[1]:
+                        self._finalize_removed_peer_locked(peer_id)
+                        return
+                    # lame duck: keep feeding it the removal entry (and
+                    # the commit index covering it — it ACKS the entry
+                    # before it learns the commit, so finalizing on match
+                    # alone would strand it unaware, election-timing-out)
+                if peer_id not in self._next_index:
+                    return
                 next_idx = self._next_index[peer_id]
                 first = self.log.first_index()
                 need_snapshot = (
@@ -481,7 +559,15 @@ class RaftNode:
             with self._mu:
                 if self.state != LEADER or self.term != term:
                     return
+                is_duck = peer_id in self._lame_ducks
+                if peer_id not in self.config.peers and not is_duck:
+                    return
                 if resp["term"] > self.term:
+                    if is_duck:
+                        # a removed-but-unaware server camps on inflated
+                        # terms from its futile elections; its responses
+                        # must not dethrone the surviving leader
+                        continue
                     self._step_down_locked(resp["term"])
                     return
                 if resp.get("success"):
@@ -491,6 +577,16 @@ class RaftNode:
                         self._maybe_advance_commit_locked()
                         if self._next_index[peer_id] <= self._last_log()[0]:
                             ev.set()  # more to send
+                    duck = self._lame_ducks.get(peer_id)
+                    if duck is not None and (
+                        commit >= duck[0]
+                        and self._match_index.get(peer_id, 0) >= duck[0]
+                    ):
+                        # the removed peer has stored the removal entry
+                        # AND seen a commit index covering it — it will
+                        # apply its own removal; drain complete
+                        self._finalize_removed_peer_locked(peer_id)
+                        return
                 else:
                     conflict = resp.get("conflict_index") or max(
                         1, self._next_index[peer_id] - 1
@@ -521,8 +617,16 @@ class RaftNode:
         if self.state != LEADER:
             return
         last, _ = self._last_log()
+        # lame-duck (removed) peers may still have match entries while
+        # their removal entry drains to them — they are NOT voters
         matches = sorted(
-            list(self._match_index.values()) + [last], reverse=True
+            [
+                m
+                for p, m in self._match_index.items()
+                if p in self.config.peers
+            ]
+            + [last],
+            reverse=True,
         )
         majority_at = matches[len(self.config.peers) // 2]
         if majority_at > self.commit_index and (
@@ -548,6 +652,7 @@ class RaftNode:
             return
         with self._mu:
             snap_index, snap_term = self.snap_index, self.snap_term
+            peers_now = dict(self.config.peers)
         try:
             resp = self._client(peer_id).call(
                 "Raft.install_snapshot",
@@ -557,6 +662,11 @@ class RaftNode:
                     "last_included_index": snap_index,
                     "last_included_term": snap_term,
                     "data": blob,
+                    # membership rides along: the compacted log may no
+                    # longer carry the RAFT_REMOVE_PEER entries, so a
+                    # bootstrapped follower must adopt the current voter
+                    # set or it would revert to its stale startup config
+                    "peers": peers_now,
                 },
                 timeout=max(self.config.rpc_timeout, 10.0),
             )
@@ -576,6 +686,12 @@ class RaftNode:
         with self._mu:
             if self._stop.is_set():
                 return {"term": self.term, "granted": False}
+            if args["candidate_id"] not in self.config.peers:
+                # a server removed from the configuration (that may not
+                # know it yet) must not be able to disrupt the cluster:
+                # refuse WITHOUT adopting its inflated term
+                # (hashicorp/raft ignores RequestVote from non-members)
+                return {"term": args["term"], "granted": False}
             if args["term"] < self.term:
                 return {"term": self.term, "granted": False}
             if args["term"] > self.term:
@@ -672,6 +788,16 @@ class RaftNode:
             self.snap_index = idx
             self.snap_term = args["last_included_term"]
             self._persist_snap_meta()
+            peers = args.get("peers")
+            if peers:
+                # adopt the leader's voter set; peers that vanished join
+                # the durable removed set so a restart (which re-derives
+                # from static config minus removals) doesn't resurrect
+                self._removed_peers |= set(self.config.peers) - set(peers)
+                self.config.peers = dict(peers)
+                if self.config.node_id not in self.config.peers:
+                    self._removed = True
+                self._persist_membership_locked()
             # discard the whole log: snapshot subsumes it
             self.log.truncate_suffix(1)
             self.last_applied = self.fsm.store.latest_index
@@ -723,6 +849,15 @@ class RaftNode:
                         err = None
                     except Exception as e:  # noqa: BLE001 — surface to waiter
                         result, err = None, e
+                    from ..server.fsm import MsgType
+
+                    if mtype == int(MsgType.RAFT_REMOVE_PEER) and payload:
+                        # membership change: committed, so every surviving
+                        # replica applies the same config transition at
+                        # the same log position
+                        self._apply_remove_peer_config(
+                            payload.get("node_id"), i
+                        )
                     with self._mu:
                         self.last_applied = max(self.last_applied, i)
                         fut = self._futures.pop(i, None)
@@ -733,6 +868,61 @@ class RaftNode:
                     else:
                         fut.set_result(result)
             self._maybe_snapshot()
+
+    def _apply_remove_peer_config(
+        self, node_id: Optional[str], removal_index: int = 0
+    ) -> None:
+        """Config transition for a committed RAFT_REMOVE_PEER entry."""
+        if not node_id:
+            return
+        with self._mu:
+            if node_id == self.config.node_id:
+                # we are the removed server: stop participating (no
+                # elections; stale-term RPCs are answered but never won)
+                self._removed = True
+                self._removed_peers.add(node_id)
+                self._persist_membership_locked()
+                if self.state == LEADER:
+                    self._step_down_locked(self.term)
+                else:
+                    self.state = FOLLOWER
+                log.info("raft: this server (%s) removed from the "
+                         "configuration", node_id)
+                return
+            if node_id not in self.config.peers:
+                return
+            del self.config.peers[node_id]
+            self._removed_peers.add(node_id)
+            self._persist_membership_locked()
+            if self.state == LEADER:
+                # lame-duck: keep replicating the removal entry to the
+                # (possibly live) removed peer so it learns and stops
+                # electing; the loop finalizes on ack or deadline
+                self._lame_ducks[node_id] = (
+                    removal_index, time.monotonic() + 5.0
+                )
+                ev = self._repl_events.get(node_id)
+                if ev is not None:
+                    ev.set()
+            else:
+                self._match_index.pop(node_id, None)
+                self._next_index.pop(node_id, None)
+            log.info("raft: removed peer %s; %d voters remain",
+                     node_id, len(self.config.peers))
+            # quorum shrank — entries may now be committed
+            self._maybe_advance_commit_locked()
+
+    def _finalize_removed_peer_locked(self, node_id: str) -> None:
+        """Drop the replication machinery for a removed peer once its
+        lame-duck window closes (ack of the removal entry or timeout)."""
+        self._lame_ducks.pop(node_id, None)
+        self._match_index.pop(node_id, None)
+        self._next_index.pop(node_id, None)
+        self._repl_events.pop(node_id, None)
+        client = self._clients.pop(node_id, None)
+        if client is not None:
+            # close outside _mu is ideal, but close() only shuts a socket
+            threading.Thread(target=client.close, daemon=True).start()
 
     def _maybe_snapshot(self) -> None:
         if (

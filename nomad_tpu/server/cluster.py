@@ -102,19 +102,100 @@ class ClusterServer:
         self._register_endpoints()
         self._forward_clients: dict[str, RPCClient] = {}
         self._fc_lock = threading.Lock()
+        # autopilot dead-server cleanup (nomad/autopilot.go): a failed
+        # gossip member that is also a raft peer is removed from the
+        # voting set after this deadline, quorum permitting
+        self.autopilot_interval = 2.0
+        self.dead_server_cleanup_after = 10.0
+        self._autopilot_stop: Optional[threading.Event] = None
+        self._autopilot_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.raft.start(self.rpc)
         if self.gossip is not None:
             self.gossip.start()
+            self._autopilot_stop = threading.Event()
+            self._autopilot_thread = threading.Thread(
+                target=self._autopilot_loop,
+                name=f"autopilot-{self.node_id}",
+                daemon=True,
+            )
+            self._autopilot_thread.start()
 
     def shutdown(self) -> None:
+        if getattr(self, "_autopilot_stop", None) is not None:
+            self._autopilot_stop.set()
         if self.gossip is not None:
             self.gossip.stop()
         if self.server._leader:
             self.server.revoke_leadership()
         self.raft.shutdown()
+
+    # -- autopilot (nomad/autopilot.go dead-server cleanup) ----------------
+    def autopilot_sweep(self) -> list:
+        """One dead-server-cleanup pass: raft peers whose gossip member
+        has been FAILED longer than the deadline are removed from the
+        voting set — IF the survivors still hold quorum on their own
+        (autopilot's guard: cleanup must never cause an outage that
+        waiting would have avoided). Returns the peer ids removed."""
+        import time as _time
+
+        if self.gossip is None or not self.raft.is_leader():
+            return []
+        members = self.gossip.members_snapshot()
+        peers = self.raft.peers()
+        removed = []
+        for pid in list(peers):
+            if pid == self.node_id:
+                continue
+            m = members.get(pid)
+            if m is None or m.status != "failed":
+                continue
+            # grace runs from the FAILED transition, not last_seen —
+            # last_seen is routinely stale for healthy-but-unprobed
+            # members, which would zero the grace for a transient blip
+            failed_at = m.failed_since or m.last_seen
+            if _time.time() - failed_at < self.dead_server_cleanup_after:
+                continue
+            # quorum guard: voters alive by gossip (self always counts).
+            # The removal entry itself must commit under the CURRENT
+            # config, so alive must reach the current-config majority —
+            # not merely the post-removal one (on even-sized clusters the
+            # post-removal bar is lower and the commit would just hang).
+            alive = sum(
+                1
+                for q in peers
+                if q != pid
+                and (
+                    q == self.node_id
+                    or (members.get(q) is not None
+                        and members[q].status == "alive")
+                )
+            )
+            post_voters = len(peers) - 1
+            need = max(len(peers) // 2 + 1, post_voters // 2 + 1)
+            if alive < need:
+                log.warning(
+                    "autopilot: NOT removing failed server %s — %d voters "
+                    "alive, need %d to commit and survive", pid, alive, need,
+                )
+                continue
+            try:
+                self.raft.remove_peer(pid)
+                removed.append(pid)
+                peers = self.raft.peers()
+                log.info("autopilot: removed dead server %s", pid)
+            except Exception:
+                log.exception("autopilot: remove_peer %s failed", pid)
+        return removed
+
+    def _autopilot_loop(self) -> None:
+        while not self._autopilot_stop.wait(self.autopilot_interval):
+            try:
+                self.autopilot_sweep()
+            except Exception:
+                log.exception("autopilot sweep failed")
 
     # -- leadership hooks (leader.go monitorLeadership) --------------------
     def _on_leader(self) -> None:

@@ -52,6 +52,10 @@ class MsgType(IntEnum):
     NAMESPACE_UPSERT = 25         # {namespace}
     NAMESPACE_DELETE = 26         # {name}
     JOB_SCALE = 27                # {job, evals, event}
+    RAFT_REMOVE_PEER = 28         # {node_id} — membership change; the
+                                  # raft layer consumes it (autopilot
+                                  # dead-server cleanup, operator raft
+                                  # remove-peer); no state-store effect
 
 
 class FSM:
@@ -288,4 +292,7 @@ _APPLIERS = {
     MsgType.NAMESPACE_UPSERT: _apply_namespace_upsert,
     MsgType.NAMESPACE_DELETE: _apply_namespace_delete,
     MsgType.JOB_SCALE: _apply_job_scale,
+    # membership change rides the log for ordering/durability but mutates
+    # raft config, not the store (RaftNode._applier intercepts it)
+    MsgType.RAFT_REMOVE_PEER: _apply_noop,
 }

@@ -49,6 +49,11 @@ class Member:
     status: str = STATUS_ALIVE
     incarnation: int = 0
     last_seen: float = field(default_factory=time.time)
+    # wall-clock of the LOCAL transition into FAILED (0 while not
+    # failed): autopilot's dead-server grace runs from this, not
+    # last_seen — last_seen goes stale for healthy-but-unprobed members,
+    # which would zero out the grace period
+    failed_since: float = 0.0
 
 
 class Gossip:
@@ -113,6 +118,19 @@ class Gossip:
                 cur = self.members.get(m.name)
                 if cur is None or m.incarnation > cur.incarnation:
                     m.last_seen = time.time()
+                    # failed_since is a LOCAL clock stamp (autopilot's
+                    # grace timer) — never adopt a remote's: keep ours if
+                    # already failed, else stamp the transition now
+                    if m.status == STATUS_FAILED:
+                        m.failed_since = (
+                            cur.failed_since
+                            if cur is not None
+                            and cur.status == STATUS_FAILED
+                            and cur.failed_since
+                            else time.time()
+                        )
+                    else:
+                        m.failed_since = 0.0
                     self.members[m.name] = m
                     if m.status == STATUS_ALIVE:
                         # revival resets the probe count — otherwise one
@@ -122,6 +140,11 @@ class Gossip:
                     # equal incarnation: suspicion/death rumors win
                     rank = {STATUS_ALIVE: 0, STATUS_SUSPECT: 1, STATUS_FAILED: 2}
                     if rank.get(m.status, 0) > rank.get(cur.status, 0):
+                        if (
+                            m.status == STATUS_FAILED
+                            and cur.status != STATUS_FAILED
+                        ):
+                            cur.failed_since = time.time()
                         cur.status = m.status
 
     def _handle_sync(self, args):
@@ -171,12 +194,22 @@ class Gossip:
         self.merge(resp.get("members") or [])
 
     def _mark_alive(self, addr: str) -> None:
+        """Direct successful contact: a LOCAL liveness observation.
+
+        SWIM incarnation ownership: only a member may bump its own
+        incarnation (refutation, memberlist's alive/suspect protocol) —
+        fabricating a higher incarnation here would let two partitioned
+        observers leapfrog each other indefinitely and suppress the
+        member's own genuine status updates cluster-wide. Status flips to
+        ALIVE at the member's current incarnation; a stale equal-
+        incarnation suspect rumor may override it transiently, and the
+        member then refutes with its own fresher incarnation on the next
+        sync it participates in — the convergent SWIM path."""
         with self._lock:
             for m in self.members.values():
                 if m.addr == addr:
-                    if m.status != STATUS_ALIVE:
-                        m.status = STATUS_ALIVE
-                        m.incarnation += 1
+                    m.status = STATUS_ALIVE
+                    m.failed_since = 0.0
                     m.last_seen = time.time()
 
     def _mark_unreachable(self, addr: str) -> None:
@@ -188,11 +221,19 @@ class Gossip:
                     continue
                 if n >= FAILED_AFTER and m.status != STATUS_FAILED:
                     m.status = STATUS_FAILED
+                    m.failed_since = time.time()
                     log.info("gossip: member %s failed", m.name)
                 elif n >= SUSPECT_AFTER and m.status == STATUS_ALIVE:
                     m.status = STATUS_SUSPECT
 
     # -- derived views -----------------------------------------------------
+    def members_snapshot(self) -> dict[str, Member]:
+        """Point-in-time copy of the member table (autopilot input)."""
+        with self._lock:
+            return {
+                name: Member(**asdict(m)) for name, m in self.members.items()
+            }
+
     def alive_members(self) -> list[Member]:
         with self._lock:
             return [

@@ -147,6 +147,7 @@ class Worker:
 
         prepared = []  # (ev, token, sched, n_asks)
         all_asks: list = []
+        lane_groups: list[int] = []  # lane -> eval ordinal (for repair)
         singles: list[tuple[Evaluation, str]] = []
         for ev, token in batch:
             if ev.type not in ("service", "batch"):
@@ -167,6 +168,7 @@ class Worker:
                 singles.append((ev, token))
             else:
                 assert sched._batch_ctx[0] is ct
+                lane_groups.extend([len(prepared)] * len(asks))
                 prepared.append((ev, token, sched, len(asks)))
                 all_asks.extend(asks)
 
@@ -194,6 +196,10 @@ class Worker:
                     all_asks,
                     results,
                     algorithm_spread=kernel.algorithm_spread,
+                    # multi-TG evals span lanes; a failed lane discards
+                    # the WHOLE eval, so repair must release (and stop
+                    # reserving for) every sibling lane too
+                    lane_groups=lane_groups,
                 )
             except Exception:
                 # shared pass failed — every prepared eval falls back to
