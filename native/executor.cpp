@@ -11,13 +11,24 @@
 //    recover(): without an owning process, exit codes read as 0).
 //
 // Usage:
-//   executor <task_dir> <stdout> <stderr> <status_file> <mem_mb> <grace_s> -- cmd [args...]
+//   executor <task_dir> <stdout> <stderr> <status_file> <mem_mb> <grace_s>
+//            [--cgroup <name>] [--cpu-mhz <n>] -- cmd [args...]
 //
-// Isolation applied to the child (the portable subset of the reference's
-// libcontainer executor): own session (setsid), RLIMIT_AS from the task
-// memory ask, no core dumps, bounded nproc. The parent forwards SIGTERM
-// to the child's process group with a 5 s grace before SIGKILL, then
-// exits with the child's exit code.
+// Isolation applied to the child, mirroring the reference's libcontainer
+// executor (drivers/shared/executor/executor_linux.go):
+//  - own session (setsid);
+//  - a PER-TASK CGROUP when --cgroup is given: cgroup v2 (memory.max,
+//    pids.max, cpu.max, kill via cgroup.kill) when the unified hierarchy
+//    carries the controllers, else cgroup v1 (memory.limit_in_bytes,
+//    pids.max, cpu.cfs_quota_us, kill by sweeping cgroup.procs). The
+//    child enrolls ITSELF (writes "0" to cgroup.procs) before exec so no
+//    grandchild can escape the hierarchy;
+//  - rlimit fallback regardless (RLIMIT_AS from the memory ask, no core
+//    dumps, bounded nproc) — on hosts without writable cgroups the task
+//    still runs bounded.
+// The parent forwards SIGTERM to the child's process group with a grace
+// period before the hard kill (cgroup.kill / procs sweep + SIGKILL),
+// removes the cgroup once empty, and exits with the child's exit code.
 
 #include <cerrno>
 #include <csignal>
@@ -38,6 +49,45 @@ static pid_t g_child = -1;
 static volatile sig_atomic_t g_killing = 0;
 static unsigned g_grace_s = 5;  // task kill_timeout, overridden by argv
 
+// cgroup state (empty when cgroups are unavailable/not requested).
+// g_cg_kill_file: v2 cgroup.kill path ("" on v1); g_cg_procs: the procs
+// file to sweep for the v1 hard kill. Written before fork, read in
+// signal context (only via open/write — async-signal-safe).
+static char g_cg_kill_file[256] = "";
+static char g_cg_procs[3][256] = {"", "", ""};
+
+static void cg_hard_kill() {
+  if (g_cg_kill_file[0]) {
+    int fd = open(g_cg_kill_file, O_WRONLY);
+    if (fd >= 0) {
+      (void)!write(fd, "1", 1);
+      close(fd);
+      return;
+    }
+  }
+  // v1: SIGKILL every pid in each controller's procs file
+  for (int c = 0; c < 3; c++) {
+    if (!g_cg_procs[c][0]) continue;
+    int fd = open(g_cg_procs[c], O_RDONLY);
+    if (fd < 0) continue;
+    char buf[4096];
+    ssize_t n = read(fd, buf, sizeof buf - 1);
+    close(fd);
+    if (n <= 0) continue;
+    buf[n] = 0;
+    long pid = 0;
+    for (char *p = buf; *p; p++) {
+      if (*p >= '0' && *p <= '9') {
+        pid = pid * 10 + (*p - '0');
+      } else if (pid > 0) {
+        kill((pid_t)pid, SIGKILL);
+        pid = 0;
+      }
+    }
+    if (pid > 0) kill((pid_t)pid, SIGKILL);
+  }
+}
+
 static void forward_term(int) {
   if (g_child > 0 && !g_killing) {
     // first TERM only: a stream of TERMs must not keep resetting the
@@ -50,6 +100,8 @@ static void forward_term(int) {
 
 static void hard_kill(int) {
   if (g_child > 0) kill(-g_child, SIGKILL);
+  cg_hard_kill();  // a forker that escaped the process group cannot
+                   // escape the cgroup
 }
 
 static long proc_start_time(pid_t pid) {
@@ -74,6 +126,92 @@ static long proc_start_time(pid_t pid) {
     }
   }
   return v;
+}
+
+static bool write_small(const std::string &path, const std::string &val) {
+  int fd = open(path.c_str(), O_WRONLY);
+  if (fd < 0) return false;
+  ssize_t n = write(fd, val.c_str(), val.size());
+  close(fd);
+  return n == (ssize_t)val.size();
+}
+
+// Create the per-task cgroup (v2 preferred, v1 split hierarchies else),
+// apply limits, and fill g_cg_* for enrollment/kill. Returns the created
+// dirs (newest last) for cleanup; empty = cgroups unavailable (rlimit
+// fallback only). Mirrors drivers/shared/executor/executor_linux.go's
+// configureCgroups.
+static std::vector<std::string> cgroup_setup(const std::string &name,
+                                             long mem_mb, long cpu_mhz) {
+  std::vector<std::string> dirs;
+  // v2 unified: needs the memory controller delegated to this level
+  FILE *f = fopen("/sys/fs/cgroup/cgroup.controllers", "r");
+  if (f) {
+    char buf[512] = {0};
+    size_t n = fread(buf, 1, sizeof buf - 1, f);
+    (void)n;
+    fclose(f);
+    if (strstr(buf, "memory")) {
+      std::string dir = "/sys/fs/cgroup/nomad-" + name;
+      if (mkdir(dir.c_str(), 0755) == 0 || errno == EEXIST) {
+        dirs.push_back(dir);
+        if (mem_mb > 0)
+          write_small(dir + "/memory.max",
+                      std::to_string(mem_mb * 1024 * 1024));
+        write_small(dir + "/pids.max", "512");
+        if (cpu_mhz > 0)
+          // 1000 MHz ask == one full core; period 100 ms
+          write_small(dir + "/cpu.max",
+                      std::to_string(cpu_mhz * 100) + " 100000");
+        snprintf(g_cg_kill_file, sizeof g_cg_kill_file, "%s/cgroup.kill",
+                 dir.c_str());
+        snprintf(g_cg_procs[0], sizeof g_cg_procs[0], "%s/cgroup.procs",
+                 dir.c_str());
+        return dirs;
+      }
+    }
+  }
+  // v1: one dir per controller hierarchy
+  struct Ctl {
+    const char *ctrl;
+    int slot;
+  } ctls[] = {{"memory", 0}, {"pids", 1}, {"cpu", 2}};
+  for (auto &c : ctls) {
+    std::string dir =
+        std::string("/sys/fs/cgroup/") + c.ctrl + "/nomad-" + name;
+    if (mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST) continue;
+    bool ok = true;
+    if (strcmp(c.ctrl, "memory") == 0 && mem_mb > 0)
+      ok = write_small(dir + "/memory.limit_in_bytes",
+                       std::to_string(mem_mb * 1024 * 1024));
+    else if (strcmp(c.ctrl, "pids") == 0)
+      ok = write_small(dir + "/pids.max", "512");
+    else if (strcmp(c.ctrl, "cpu") == 0 && cpu_mhz > 0) {
+      write_small(dir + "/cpu.cfs_period_us", "100000");
+      ok = write_small(dir + "/cpu.cfs_quota_us",
+                       std::to_string(cpu_mhz * 100));
+    }
+    if (!ok) {
+      rmdir(dir.c_str());
+      continue;
+    }
+    dirs.push_back(dir);
+    snprintf(g_cg_procs[c.slot], sizeof g_cg_procs[c.slot],
+             "%s/cgroup.procs", dir.c_str());
+  }
+  return dirs;
+}
+
+static void cgroup_cleanup(const std::vector<std::string> &dirs) {
+  // procs must drain before rmdir succeeds; bounded retry
+  for (int attempt = 0; attempt < 50; attempt++) {
+    bool all = true;
+    for (const auto &d : dirs)
+      if (rmdir(d.c_str()) != 0 && errno != ENOENT) all = false;
+    if (all) return;
+    usleep(100 * 1000);
+    cg_hard_kill();  // stragglers keep the dir busy
+  }
 }
 
 static void write_status(const std::string &path, const std::string &line) {
@@ -102,17 +240,26 @@ int main(int argc, char **argv) {
   long mem_mb = atol(argv[5]);
   long grace = atol(argv[6]);
   if (grace > 0) g_grace_s = (unsigned)grace;
+  std::string cg_name;
+  long cpu_mhz = 0;
   int cmd_at = -1;
   for (int i = 7; i < argc; i++) {
     if (strcmp(argv[i], "--") == 0) {
       cmd_at = i + 1;
       break;
     }
+    if (strcmp(argv[i], "--cgroup") == 0 && i + 1 < argc)
+      cg_name = argv[++i];
+    else if (strcmp(argv[i], "--cpu-mhz") == 0 && i + 1 < argc)
+      cpu_mhz = atol(argv[++i]);
   }
   if (cmd_at < 0 || cmd_at >= argc) {
     fprintf(stderr, "executor: missing -- command\n");
     return 2;
   }
+
+  std::vector<std::string> cg_dirs;
+  if (!cg_name.empty()) cg_dirs = cgroup_setup(cg_name, mem_mb, cpu_mhz);
 
   // Block stop signals across fork so a SIGTERM delivered before the
   // handlers are registered is queued, not fatal: an unhandled TERM in
@@ -135,7 +282,20 @@ int main(int argc, char **argv) {
     // --- child: isolate, redirect, exec -------------------------------
     sigprocmask(SIG_SETMASK, &prev_set, nullptr);
     setsid();
+    // enroll in the task cgroup BEFORE exec: every process the task
+    // forks inherits membership — escape by double-fork is impossible
+    for (int c = 0; c < 3; c++) {
+      if (!g_cg_procs[c][0]) continue;
+      int fd = open(g_cg_procs[c], O_WRONLY);
+      if (fd >= 0) {
+        (void)!write(fd, "0", 1);  // "0" = the writing process itself
+        close(fd);
+      }
+    }
     struct rlimit rl;
+    // rlimits stay as the portable fallback; with a memory cgroup the
+    // AS bound is left loose (cgroup RSS accounting is the real limit,
+    // and a tight AS bound kills mmap-heavy runtimes spuriously)
     rl.rlim_cur = rl.rlim_max = (rlim_t)(mem_mb + 512) * 1024 * 1024;
     setrlimit(RLIMIT_AS, &rl);
     rl.rlim_cur = rl.rlim_max = 0;
@@ -175,6 +335,10 @@ int main(int argc, char **argv) {
   if (r == g_child) {
     if (WIFEXITED(wstatus)) code = WEXITSTATUS(wstatus);
     else if (WIFSIGNALED(wstatus)) code = 128 + WTERMSIG(wstatus);
+  }
+  if (!cg_dirs.empty()) {
+    cg_hard_kill();  // reap stray descendants the task left behind
+    cgroup_cleanup(cg_dirs);
   }
   write_status(status_path, "exit " + std::to_string(code) + "\n");
   return code;

@@ -801,10 +801,20 @@ class HTTPAgent:
         pid = (query.get("id") or [""])[0]
         if not pid:
             raise APIError(400, "missing ?id=<node_id>")
+        from ..raft import NotLeaderError
+
         try:
             self.server.raft.remove_peer(pid)
         except ValueError as e:
             raise APIError(400, str(e))
+        except NotLeaderError as e:
+            # membership changes commit on the leader; tell the operator
+            # where to retry instead of a bare 500 (the CLI surfaces it)
+            raise APIError(
+                421,
+                f"not the leader — retry against "
+                f"{e.leader_addr or e.leader_id or 'the leader'}",
+            )
         return {"removed": pid}
 
     def handle_job_dispatch(self, method, body, query, job_id):

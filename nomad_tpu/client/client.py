@@ -85,6 +85,11 @@ class Client:
         self.heartbeat_interval = heartbeat_interval
         self.runners: dict[str, AllocRunner] = {}
         self._pending_updates: dict[str, Allocation] = {}
+        # alloc id → client-side health verdict (allochealth tracker);
+        # attached to every subsequent sync so a later task-state update
+        # can't erase the verdict in flight
+        self._health_verdicts: dict[str, bool] = {}
+        self._health_trackers: dict[str, object] = {}
         self._lock = threading.Lock()
         self._logmon_lock = threading.Lock()  # serializes log rotation
         self._stop = threading.Event()
@@ -174,6 +179,7 @@ class Client:
             threading.Thread(
                 target=runner.run, name=f"alloc-{alloc.id[:8]}", daemon=True
             ).start()
+            self._maybe_track_health(runner)
 
     # -- heartbeats --------------------------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -310,6 +316,7 @@ class Client:
                 if alloc_id in self._terminal_order:
                     self._terminal_order.remove(alloc_id)
             self._acked_terminal.discard(alloc_id)  # bound the ack set
+            self._drop_health_tracking(alloc_id)
             if runner is not None:
                 runner.destroy()
             self.state_db.delete_alloc(alloc_id)
@@ -342,9 +349,11 @@ class Client:
                 runner.destroy()
                 self.state_db.delete_alloc(alloc_id)
                 self._acked_terminal.discard(alloc_id)
+                self._drop_health_tracking(alloc_id)
                 with self._lock:
                     self.runners.pop(alloc_id, None)
             elif a.desired_status in (ALLOC_DESIRED_STOP, "evict"):
+                self._drop_health_tracking(alloc_id)
                 if not runner._destroyed:
                     runner.stop()
         # start new
@@ -365,6 +374,7 @@ class Client:
             threading.Thread(
                 target=runner.run, name=f"alloc-{alloc_id[:8]}", daemon=True
             ).start()
+            self._maybe_track_health(runner)
 
     def _watch_previous_alloc(self, prev_id: str, timeout: float = 60.0):
         """allocwatcher (client/allocwatcher): block until the previous
@@ -383,6 +393,66 @@ class Client:
             time.sleep(0.05)
         return None
 
+    # -- alloc health (client/allochealth tracker) -------------------------
+    def _maybe_track_health(self, runner) -> None:
+        """Deployment allocs get a health tracker: task states + service
+        checks gate DeploymentStatus.Healthy (tracker.go). Checkless
+        groups stay on the server-side continuous-running fallback."""
+        alloc = runner.alloc
+        if not getattr(alloc, "deployment_id", None):
+            return
+        from .allochealth import AllocHealthTracker, group_checks
+
+        if not group_checks(alloc.job, alloc.task_group):
+            return  # no checks: server-side task_states fallback applies
+        job = alloc.job
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        tracker = AllocHealthTracker(
+            runner,
+            getattr(tg, "update", None),
+            on_health=self._on_alloc_health,
+        )
+        with self._lock:
+            self._health_trackers[alloc.id] = tracker
+        tracker.start()
+
+    def _drop_health_tracking(self, alloc_id: str) -> None:
+        """Stop the tracker and prune the verdict when an alloc leaves
+        this client (stopped/GC'd) — a live tracker would keep probing
+        ports that may already belong to a new alloc."""
+        with self._lock:
+            tracker = self._health_trackers.pop(alloc_id, None)
+            self._health_verdicts.pop(alloc_id, None)
+        if tracker is not None:
+            tracker.stop()
+
+    def _on_alloc_health(self, alloc_id: str, healthy: bool) -> None:
+        from ..structs.deployment import AllocDeploymentStatus
+
+        with self._lock:
+            self._health_verdicts[alloc_id] = healthy
+            runner = self.runners.get(alloc_id)
+        if runner is None:
+            return
+        upd = runner.alloc.copy_for_update()
+        # client_status is the task lifecycle's to report — health is a
+        # separate verdict; the verdict rides the regular alloc sync and
+        # the store merges it onto the server copy for the watcher
+        upd.deployment_status = AllocDeploymentStatus(
+            healthy=healthy, timestamp_unix=time.time()
+        )
+        upd.task_states = {
+            name: {
+                "state": s.state,
+                "failed": s.failed,
+                "restarts": s.restarts,
+            }
+            for name, s in runner.task_states.items()
+        }
+        with self._lock:
+            self._pending_updates[alloc_id] = upd
+        self.state_db.put_alloc(upd)
+
     # -- status sync -------------------------------------------------------
     def _on_alloc_update(self, alloc: Allocation, status: str, task_states) -> None:
         upd = alloc.copy_for_update()
@@ -391,6 +461,13 @@ class Client:
             name: {"state": s.state, "failed": s.failed, "restarts": s.restarts}
             for name, s in task_states.items()
         }
+        verdict = self._health_verdicts.get(alloc.id)
+        if verdict is not None:
+            from ..structs.deployment import AllocDeploymentStatus
+
+            upd.deployment_status = AllocDeploymentStatus(
+                healthy=verdict, timestamp_unix=time.time()
+            )
         with self._lock:
             self._pending_updates[alloc.id] = upd
         if self.publish_allocation_metrics:

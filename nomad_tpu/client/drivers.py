@@ -368,6 +368,16 @@ class ExecDriver(RawExecDriver):
         except OSError:
             pass
         grace = int(getattr(task, "kill_timeout_s", 5.0) or 5.0)
+        handle_id = str(uuid.uuid4())
+        extra: list[str] = []
+        # per-task cgroup (drivers/shared/executor cgroup confinement):
+        # only meaningful when this process may create cgroups — probe
+        # once per driver instance
+        if self._cgroups_available():
+            extra += ["--cgroup", handle_id[:18]]
+            res = getattr(task, "resources", None)
+            if res is not None and getattr(res, "cpu", 0):
+                extra += ["--cpu-mhz", str(int(res.cpu))]
         try:
             proc = subprocess.Popen(
                 [
@@ -378,8 +388,9 @@ class ExecDriver(RawExecDriver):
                     status_file,
                     str(mem_mb),
                     str(grace),
-                    "--",
                 ]
+                + extra
+                + ["--"]
                 + argv,
                 cwd=task_dir,
                 env={
@@ -394,13 +405,25 @@ class ExecDriver(RawExecDriver):
             )
         except OSError as e:
             raise DriverError(f"failed to exec supervisor: {e}") from e
-        h = TaskHandle(id=str(uuid.uuid4()), driver=self.name, pid=proc.pid)
+        h = TaskHandle(id=handle_id, driver=self.name, pid=proc.pid)
         h.meta["proc_start"] = _proc_start_time(proc.pid)
         h.meta["status_file"] = status_file
         h.meta["supervised"] = True
         h.meta["grace_s"] = float(grace)
         self._procs[h.id] = proc
         return h
+
+    @staticmethod
+    def _cgroups_available() -> bool:
+        """Can this agent create task cgroups? v2 unified with memory
+        delegated, or v1 memory hierarchy, writable by us."""
+        try:
+            with open("/sys/fs/cgroup/cgroup.controllers") as f:
+                if "memory" in f.read():
+                    return os.access("/sys/fs/cgroup", os.W_OK)
+        except OSError:
+            pass
+        return os.access("/sys/fs/cgroup/memory", os.W_OK)
 
     def _read_status_raw(self, handle) -> tuple[str, Optional[int], Optional[int]]:
         """The supervisor's durable status record:
@@ -558,6 +581,14 @@ class ExecDriver(RawExecDriver):
 
 def builtin_drivers() -> dict[str, TaskDriver]:
     """The in-process driver catalog (helper/pluginutils/catalog analog)."""
+    from .container import ContainerDriver
+
     return {
-        d.name: d for d in (MockDriver(), RawExecDriver(), ExecDriver())
+        d.name: d
+        for d in (
+            MockDriver(),
+            RawExecDriver(),
+            ExecDriver(),
+            ContainerDriver(),
+        )
     }

@@ -63,7 +63,9 @@ class DeviceStateCache:
     # -- refresh machinery -------------------------------------------------
     def _rebuild_locked(self, snap) -> ClusterTensors:
         self.full_flattens += 1
-        self._ct = flatten_cluster(snap)
+        self._ct = replace(
+            flatten_cluster(snap), layout_gen=self.full_flattens
+        )
         return self._ct
 
     def _refresh_locked(self, snap) -> ClusterTensors:
@@ -182,5 +184,8 @@ class DeviceStateCache:
             node_row=node_row,
             nodes=nodes,
             attr_cache=attr_cache,
+            # incremental refresh never reorders existing rows (new nodes
+            # append) — row-indexed overlays stay valid
+            layout_gen=ct.layout_gen,
         )
         return self._ct

@@ -77,6 +77,12 @@ class ClusterTensors:
     # attribute → (value_ids i32[N], vocab dict) — lazily built columns for
     # spread/property attributes, owned by the cache generation
     attr_cache: dict = field(default_factory=dict)
+    # row-layout generation: bumped ONLY by a full reflatten (which may
+    # re-sort rows); preserved across incremental refreshes and the
+    # per-call used-copy. Consumers holding row-indexed overlays (the
+    # worker's pipelined usage epoch) compare this to decide whether
+    # their row indices still align. 0 = transient build, never matches.
+    layout_gen: int = 0
 
     @property
     def padded_n(self) -> int:

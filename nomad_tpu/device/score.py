@@ -690,6 +690,14 @@ def place_spread_opv_kernel(
         safe_vids = jnp.maximum(vids, 0)  # [B, N]
         evids = jnp.take(vids, eidx, axis=0)  # [N] enforce-block values
         seg = jnp.where(evids >= 0, evids, nv)  # [N]; nv = no-value segment
+        # which enforce-block values actually exist on an eligible node:
+        # V is padded to a power of two, and a phantom value with count 0
+        # must not read as "empty" to the rotation guard (it would lock
+        # the rotation onto unreachable segments and starve the chunk)
+        present_v = jnp.any(
+            (evids[None, :] == jnp.arange(nv)[:, None]) & elig[None, :],
+            axis=1,
+        )  # [V]
 
         def node_scores(head_num, head_den, head_ok, c):
             tbl, allow = _block_tables(c, desired, vcaps, weights, kinds)
@@ -745,12 +753,49 @@ def place_spread_opv_kernel(
 
             score1 = node_scores(head_num, head_den, head_fit, c1)
             score1 = jnp.where(seg == v_first, -jnp.inf, score1)
+            # Rotation guard: stepwise greedy only places on values at
+            # the (positive) minimum count — or still empty — of the
+            # dominant even block; each placement removes that value from
+            # the min set. A chunk that keeps taking beyond the min set
+            # pays the symmetric-state −1 boost for its tail picks and
+            # diverges from greedy (measured 11% corpus score loss at
+            # config-3). Restrict the one-per-value picks to the rotating
+            # set; the chunk under-fills and later chunks (or the host
+            # repair re-score) finish the remainder exactly.
+            ecounts = c1[eidx]  # [V] enforce-block counts after the bump
+            pos1 = ecounts > 0
+            minc1 = jnp.min(jnp.where(pos1, ecounts, jnp.inf))
+            maxc1 = jnp.max(jnp.where(pos1, ecounts, -jnp.inf))
+            empty_v = (~pos1) & present_v  # reachable and still unused
+            no_empty = ~jnp.any(empty_v)
+            # greedy's rotation set under even spread: empty values while
+            # any exist (+1 boost beats every filled value's); otherwise
+            # the at-min values — but only once the bump broke symmetry
+            # (minc==maxc ⇒ every value scores the −1 symmetric boost;
+            # greedy pays that once per ROUND, not once per pick — the
+            # chunk's single first-pick is that once, and the next
+            # chunk's re-derived table continues from the broken state)
+            rotate_ok = jnp.where(
+                no_empty,
+                pos1 & (ecounts <= minc1) & (maxc1 > minc1),
+                empty_v,
+            )
+            is_even_enforce = (
+                jnp.take(kinds, eidx) == BLOCK_EVEN_SPREAD
+            )
+            seg_allowed = jnp.concatenate(
+                [
+                    jnp.where(is_even_enforce, rotate_ok, True),
+                    jnp.ones(1, dtype=bool),  # value-less segment
+                ]
+            )
             # dense masked segment-max — TPU scatters serialize, masked
             # compare+reduce rides the VPU ([V+1, N] is small)
             seg_plane = seg[None, :] == jnp.arange(nv + 1)[:, None]
             seg_max = jnp.max(
                 jnp.where(seg_plane, score1[None, :], -jnp.inf), axis=1
             )
+            seg_max = jnp.where(seg_allowed, seg_max, -jnp.inf)
             vals, vsel = jax.lax.top_k(seg_max, k_seg - 1)
             take_r = (
                 jnp.arange(k_seg - 1) + n_placed + ok0.astype(jnp.int32)
@@ -971,6 +1016,7 @@ class PlacementKernel:
         overflow: int = OVERFLOW_CANDIDATES,
         decorrelate: bool = False,
         decorrelate_salt: int = 0,
+        used_override=None,  # [pn, D] optimistic usage (pipelined passes)
     ) -> list[PlacementResult]:
         """``overflow`` = extra greedy candidates emitted per lane for
         conflict repair. ``decorrelate``: stripe each lane onto a disjoint
@@ -983,10 +1029,17 @@ class PlacementKernel:
         instead of stripe-for-stripe."""
         if not asks:
             return []
+        used0 = (
+            np.asarray(cluster.used)
+            if used_override is None
+            else np.asarray(used_override)
+        )
         work = asks
         jitter = None
         if decorrelate:
-            work = _decorrelate_lanes(cluster, asks, salt=decorrelate_salt)
+            work = _decorrelate_lanes(
+                cluster, asks, salt=decorrelate_salt, used0=used0
+            )
             rows = np.arange(cluster.padded_n, dtype=np.int64)
             h = (rows * 2654435761 + (decorrelate_salt + 1) * 40503) & 0xFFFFFFFF
             jitter = ((h % 65536).astype(np.float32) / 65536.0) * 2e-5
@@ -1015,7 +1068,10 @@ class PlacementKernel:
             if idxs:
                 for i, r in zip(
                     idxs,
-                    fn(cluster, [work[i] for i in idxs], overflow, jitter),
+                    fn(
+                        cluster, [work[i] for i in idxs], overflow, jitter,
+                        used0,
+                    ),
                 ):
                     out[i] = r
         return out
@@ -1058,8 +1114,10 @@ class PlacementKernel:
 
     def _place_closed_form(
         self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES,
-        jitter=None,
+        jitter=None, used0=None,
     ) -> list[PlacementResult]:
+        if used0 is None:
+            used0 = np.asarray(cluster.used)
         pn = cluster.padded_n
         max_count = max(a.count for a in asks)
         k = _steps_bucket(max(max_count + overflow, 1))
@@ -1076,7 +1134,7 @@ class PlacementKernel:
             for i in range(0, len(asks), chunk):
                 out.extend(
                     self._place_closed_form(
-                        cluster, asks[i:i + chunk], overflow, jitter
+                        cluster, asks[i:i + chunk], overflow, jitter, used0
                     )
                 )
             return out
@@ -1087,7 +1145,7 @@ class PlacementKernel:
         fused = np.array(
             place_closed_form_kernel(
                 jnp.asarray(cluster.capacity),
-                jnp.asarray(cluster.used),
+                jnp.asarray(used0),
                 **{kk: jnp.asarray(v) for kk, v in batch.items()},
                 algorithm_spread=jnp.asarray(self.algorithm_spread),
                 max_j=max_j,
@@ -1109,8 +1167,10 @@ class PlacementKernel:
 
     def _place_scan_batch(
         self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES,
-        jitter=None,
+        jitter=None, used0=None,
     ) -> list[PlacementResult]:
+        if used0 is None:
+            used0 = np.asarray(cluster.used)
         from .flatten import pad_value_blocks
 
         pn = cluster.padded_n
@@ -1132,7 +1192,7 @@ class PlacementKernel:
         batch.update(pad_value_blocks([a.blocks for a in asks], pn))
         choices, scores = place_value_scan_kernel(
             jnp.asarray(cluster.capacity),
-            jnp.asarray(cluster.used),
+            jnp.asarray(used0),
             **{k: jnp.asarray(v) for k, v in batch.items()},
             algorithm_spread=jnp.asarray(self.algorithm_spread),
             max_j=max_j,
@@ -1143,8 +1203,10 @@ class PlacementKernel:
 
     def _place_spread_chunked(
         self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES,
-        jitter=None,
+        jitter=None, used0=None,
     ) -> list[PlacementResult]:
+        if used0 is None:
+            used0 = np.asarray(cluster.used)
         from .flatten import pad_value_blocks
 
         pn = cluster.padded_n
@@ -1167,7 +1229,7 @@ class PlacementKernel:
         batch.update(pad_value_blocks([a.blocks for a in asks], pn))
         choices, scores = place_spread_chunked_kernel(
             jnp.asarray(cluster.capacity),
-            jnp.asarray(cluster.used),
+            jnp.asarray(used0),
             **{k: jnp.asarray(v) for k, v in batch.items()},
             algorithm_spread=jnp.asarray(self.algorithm_spread),
             max_j=max_j,
@@ -1179,8 +1241,10 @@ class PlacementKernel:
 
     def _place_spread_opv(
         self, cluster, asks: list, overflow: int = OVERFLOW_CANDIDATES,
-        jitter=None,
+        jitter=None, used0=None,
     ) -> list[PlacementResult]:
+        if used0 is None:
+            used0 = np.asarray(cluster.used)
         from .flatten import pad_value_blocks
 
         pn = cluster.padded_n
@@ -1220,8 +1284,18 @@ class PlacementKernel:
                 lane_steps, -(-(a.count + overflow) // per_chunk)
             )
         # multiple-of-4 rounding, not power-of-two (sequential depth is
-        # the dominant cost; see _place_spread_chunked)
-        n_chunks = max(4, -(-lane_steps // 4) * 4)
+        # the dominant cost; see _place_spread_chunked). +2 slack chunks:
+        # the rotation guard makes a chunk starting from uneven counts
+        # yield fewer than v_act picks; the host repair re-score rescues
+        # any residue, but slack keeps that path cold.
+        n_chunks = max(4, -(-(lane_steps + 2) // 4) * 4)
+        # J bound tightened by the kernel's own structure: each chunk
+        # step picks DISTINCT nodes (the first pick and the one-per-value
+        # segment picks are disjoint), so one node gains at most one
+        # instance per step — head_j never exceeds n_chunks. At config-3
+        # shape this cuts the [N, J] planes 4× (J 96 → 24): plane
+        # construction dominates the pass, so it's ~linear wall-clock.
+        max_j = min(max_j, self._j_bucket(n_chunks + 1))
 
         batch["counts"] = np.minimum(
             batch["counts"] + overflow, n_chunks * k_seg
@@ -1231,7 +1305,7 @@ class PlacementKernel:
         ).astype(np.int32)
         choices, scores = place_spread_opv_kernel(
             jnp.asarray(cluster.capacity),
-            jnp.asarray(cluster.used),
+            jnp.asarray(used0),
             **{k: jnp.asarray(v) for k, v in batch.items()},
             enforce_idx=jnp.asarray(enforce_idx),
             algorithm_spread=jnp.asarray(self.algorithm_spread),
@@ -1278,7 +1352,7 @@ class PlacementKernel:
         return out
 
 
-def _decorrelate_lanes(cluster, asks: list, salt: int = 0) -> list:
+def _decorrelate_lanes(cluster, asks: list, salt: int = 0, used0=None) -> list:
     """Stripe each batch lane onto a disjoint subset of node rows
     (row % n_lanes == lane). Concurrent lanes scoring the same snapshot
     otherwise compute near-identical greedy sequences and pile onto the
@@ -1313,7 +1387,9 @@ def _decorrelate_lanes(cluster, asks: list, salt: int = 0) -> list:
     row_hash = (rows.astype(np.uint64) * np.uint64(2654435761)) & np.uint64(
         0xFFFFFFFF
     )
-    free = np.asarray(cluster.capacity) - np.asarray(cluster.used)  # [pn, D]
+    free = np.asarray(cluster.capacity) - (
+        np.asarray(cluster.used) if used0 is None else np.asarray(used0)
+    )  # [pn, D]
     out = []
     for i, a in enumerate(asks):
         if a.count <= 0:
@@ -1463,6 +1539,7 @@ def repair_batch_conflicts(
     algorithm_spread: bool = False,
     fail_on_contention: bool = False,
     lane_groups: Optional[list] = None,
+    used_override=None,  # [pn, D] optimistic base usage (pipelined passes)
 ) -> list[bool]:
     """Host-side optimistic-conflict resolution for one batched pass.
 
@@ -1494,7 +1571,11 @@ def repair_batch_conflicts(
     discarded plan must not stay reserved against later lanes.
     """
     capacity = np.asarray(cluster.capacity)
-    used0 = np.asarray(cluster.used)
+    used0 = (
+        np.asarray(cluster.used)
+        if used_override is None
+        else np.asarray(used_override)
+    )
     used = used0.copy()
     ok_lanes: list[bool] = []
     # group id -> [(placed_on_node, ask), ...] commit journal for rollback

@@ -84,6 +84,19 @@ class DeploymentWatcher:
                     continue
                 if a.client_status == "failed" or a.client_status == "lost":
                     unhealthy_ids.append(a.id)
+                elif self._has_checks(job, a.task_group):
+                    # checked groups: health is the CLIENT's verdict
+                    # (allochealth tracker via alloc sync) — the
+                    # continuous-running fallback would let a
+                    # crash-looping-but-restarting task pass canary
+                    # gates. Only the healthy_deadline backstop applies
+                    # server-side (a disconnected client must not park
+                    # the deployment forever).
+                    since = self._running_since.setdefault(a.id, now)
+                    if now - since >= self._healthy_deadline(
+                        job, a.task_group
+                    ):
+                        unhealthy_ids.append(a.id)
                 elif a.client_status == "running" and not a.terminal_status():
                     mht = self._min_healthy_time(job, a.task_group)
                     since = self._running_since.setdefault(a.id, now)
@@ -181,6 +194,31 @@ class DeploymentWatcher:
         if tg is None or tg.update is None:
             return 0.0
         return tg.update.min_healthy_time_s
+
+    @staticmethod
+    def _healthy_deadline(job, tg_name: str) -> float:
+        if job is None:
+            return 300.0
+        tg = job.lookup_task_group(tg_name)
+        if tg is None or tg.update is None:
+            return 300.0
+        return tg.update.healthy_deadline_s
+
+    @staticmethod
+    def _has_checks(job, tg_name: str) -> bool:
+        """Does this group carry service health checks? (allochealth
+        gating: client-reported verdicts replace the running-time
+        fallback.)"""
+        if job is None:
+            return False
+        tg = job.lookup_task_group(tg_name)
+        if tg is None:
+            return False
+        return any(
+            (svc.checks or [])
+            for task in tg.tasks
+            for svc in (getattr(task, "services", None) or [])
+        )
 
     # -- actions -----------------------------------------------------------
     def promote(self, deployment_id: str) -> bool:

@@ -11,6 +11,15 @@ a fresher snapshot.
 The TPU twist (SURVEY.md §2.7): one worker drives a *batched* device pass,
 so a single worker replaces N CPU-bound Go workers for placement; multiple
 workers still make sense to overlap host-side reconcile/flatten work.
+
+Pipelining (the plan_apply.go:49-69 analog): the device pass for batch
+k+1 overlaps the host-side COMMIT of batch k. The worker hands each
+finished pass to a commit thread and immediately dequeues + prepares the
+next one; the next pass scores against an OPTIMISTIC usage overlay (the
+previous pass's placements, not yet committed), exactly how the
+reference's applier evaluates plan N+1 against the optimistic post-N
+snapshot. The serialized plan applier remains the authority — an overlay
+mis-guess surfaces as a partial commit and an individual retry.
 """
 
 from __future__ import annotations
@@ -18,6 +27,8 @@ from __future__ import annotations
 import logging
 import threading
 from typing import Optional
+
+import numpy as np
 
 from ..scheduler import new_scheduler
 from ..structs import Evaluation, Plan
@@ -39,9 +50,42 @@ SCHEDULER_TYPES = ["service", "batch", "system", "sysbatch", "_core"]
 # Only worker 0 runs the batched pass: two workers batching the same
 # snapshot double-book capacity and the applier bounces the later plans
 # (measured conflict_rate 0 → 0.46 at 64-deep with two batching
-# workers). The remaining workers drain evals one at a time, overlapping
-# host-side reconcile/flatten work with the batch worker's device pass.
+# workers). The remaining workers drain solo evals, overlapping host-side
+# reconcile/flatten work with the batch worker's device pass.
 EVAL_BATCH_SIZE = 64
+
+
+class _TokenPlanner:
+    """Planner bound to ONE eval's broker token. Batch completion runs on
+    the commit thread concurrently with the next pass's prepare, so the
+    token cannot live as mutable worker state (worker.go keeps it as
+    per-worker state because its workers are strictly serial)."""
+
+    def __init__(self, worker: "Worker", token: str):
+        self._worker = worker
+        self.token = token
+
+    def submit_plan(self, plan: Plan):
+        plan.eval_token = self.token
+        plan.normalize()
+        server = self._worker.server
+        with metrics.timer("nomad.worker.submit_plan"):
+            future = server.plan_queue.enqueue(plan)
+            result = future.result(timeout=30)
+        new_snapshot = None
+        if result.refresh_index:
+            server.store.wait_for_index(result.refresh_index, timeout=5.0)
+            new_snapshot = server.store.snapshot()
+        return result, new_snapshot
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self._worker.server.apply_eval_update([ev])
+
+    def create_eval(self, ev: Evaluation) -> None:
+        self._worker.server.apply_eval_create([ev])
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self._worker.server.eval_broker.enqueue(ev)
 
 
 class Worker:
@@ -52,8 +96,26 @@ class Worker:
         self._stop = threading.Event()
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._eval_token: str = ""
+        # the commit thread and the worker thread both account evals —
+        # bare dict increments would lose counts across the interleave
         self.stats = {"processed": 0, "acked": 0, "nacked": 0}
+        self._stats_lock = threading.Lock()
+        # Pipelining state (batch worker only). The optimistic overlay is
+        # EPOCH-based: ct.used is refreshed in place by the device cache
+        # as the previous pass's plans commit, so "ct.used + overlay"
+        # double-counts whatever already landed. Instead the epoch pins a
+        # COPY of used taken when the pipeline went in-flight; every
+        # in-flight pass's placements accumulate into the epoch delta,
+        # and the epoch resets (fresh copy, zero delta) whenever the
+        # commit thread has fully drained.
+        self._commit_thread: Optional[threading.Thread] = None
+        self._epoch_used: Optional[np.ndarray] = None  # frozen [pn, D]
+        self._epoch_delta: Optional[np.ndarray] = None  # in-flight sum
+        # row-layout generation the epoch's indices align with: tensors()
+        # returns a fresh wrapper object per call, so OBJECT identity is
+        # useless — layout_gen changes only on a full reflatten (the only
+        # event that reorders rows)
+        self._epoch_layout_gen: int = -1
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -67,6 +129,7 @@ class Worker:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
+        self._join_commit(timeout=5)
 
     def pause(self) -> None:
         """Leader pauses half its workers (nomad/leader.go:231-233)."""
@@ -75,10 +138,22 @@ class Worker:
     def resume(self) -> None:
         self._paused.clear()
 
+    def _bump(self, *keys: str) -> None:
+        with self._stats_lock:
+            for k in keys:
+                self.stats[k] += 1
+
+    def _join_commit(self, timeout: float = 60.0) -> None:
+        t = self._commit_thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._commit_thread = None
+
     # -- main loop ---------------------------------------------------------
     def run(self) -> None:
         while not self._stop.is_set():
             if self._paused.is_set():
+                self._join_commit()
                 self._stop.wait(0.1)
                 continue
             with metrics.timer("nomad.worker.dequeue_eval"):
@@ -88,6 +163,7 @@ class Worker:
                     timeout=0.2,
                 )
             if not batch:
+                self._join_commit()
                 continue
             try:
                 if len(batch) == 1:
@@ -106,32 +182,50 @@ class Worker:
                 for ev, token in batch:
                     try:
                         self.server.eval_broker.nack(ev.id, token)
-                        self.stats["nacked"] += 1
+                        self._bump("nacked")
                     except ValueError:
                         pass  # already acked/nacked
+        self._join_commit()
 
     def _run_one(self, ev: Evaluation, token: str) -> None:
-        self._eval_token = token
+        planner = _TokenPlanner(self, token)
         try:
-            self.process_eval(ev)
+            self.process_eval(ev, planner)
             self.server.eval_broker.ack(ev.id, token)
-            self.stats["acked"] += 1
+            self._bump("acked")
         except Exception:
             log.exception("worker %d: eval %s failed", self.id, ev.id)
             try:
                 self.server.eval_broker.nack(ev.id, token)
             except ValueError:
                 pass
-            self.stats["nacked"] += 1
-        self.stats["processed"] += 1
+            self._bump("nacked", "processed")
         # per-eval counter: the invoke_scheduler TIMER emits one sample per
         # batched pass, so throughput accounting reads this counter instead
         metrics.incr("nomad.worker.evals_processed")
 
     def _run_batch(self, batch: list[tuple[Evaluation, str]]) -> None:
-        """Process a batch of evals through one combined device pass.
-        Evals the batch path can't take (system jobs, eviction-coupled
-        plans, failed batch attempts) fall back to the individual path."""
+        """Run a batch of evals through one combined device pass, then
+        hand the commit to the pipeline thread and return — the NEXT
+        pass's prepare + device time overlaps this pass's commit."""
+        # Pipeline state must be decided BEFORE the snapshot: if the
+        # previous commit finished between snapshot and the check, the
+        # snapshot would miss its writes while the epoch (and its
+        # overlay) had already been dropped — this pass would then score
+        # against stale usage and overbook (measured as a full pass of
+        # applier partial-commit fallbacks). Checking first makes the
+        # race benign: a commit finishing right after the check leaves
+        # the epoch active, which merely over-reserves.
+        commit_busy = (
+            self._commit_thread is not None
+            and self._commit_thread.is_alive()
+        )
+        if not commit_busy:
+            self._join_commit()  # reap the finished thread
+            metrics.incr("nomad.worker.pipeline_epoch_resets")
+            self._epoch_used = None
+            self._epoch_delta = None
+            self._epoch_layout_gen = -1
         with metrics.timer("nomad.worker.wait_for_index"):
             self.server.store.wait_for_index(
                 max(ev.modify_index for ev, _ in batch), timeout=5.0
@@ -153,9 +247,11 @@ class Worker:
             if ev.type not in ("service", "batch"):
                 singles.append((ev, token))
                 continue
-            self._eval_token = token
             sched = new_scheduler(
-                ev.type, snapshot, self, cache=self.server.device_cache
+                ev.type,
+                snapshot,
+                _TokenPlanner(self, token),
+                cache=self.server.device_cache,
             )
             try:
                 asks = sched.prepare_batch_attempt(ev, ct=ct)
@@ -175,6 +271,28 @@ class Worker:
         results = None
         lane_ok: list[bool] = []
         if all_asks:
+            # Optimistic overlay: the previous pass's placements are not
+            # committed yet (its commit thread is running) but the applier
+            # WILL land most of them — scoring this pass against bare
+            # ct.used would double-book those nodes, while ct.used PLUS
+            # the raw delta double-counts whatever the cache already
+            # refreshed in. Epoch accounting keeps it consistent: a used
+            # copy frozen when the pipeline went in-flight, plus every
+            # in-flight pass's delta.
+            used_override = None
+            if (
+                self._epoch_used is not None
+                and self._epoch_layout_gen != ct.layout_gen
+            ):
+                # full reflatten changed row order mid-epoch: the frozen
+                # base no longer aligns — drop the overlay (the applier
+                # remains the authority on any resulting double-booking)
+                self._epoch_used = None
+                self._epoch_delta = None
+                self._epoch_layout_gen = -1
+            if self._epoch_used is not None:
+                metrics.incr("nomad.worker.pipeline_override_passes")
+                used_override = self._epoch_used + self._epoch_delta
             try:
                 kernel = prepared[0][2].kernel
                 with metrics.timer("nomad.worker.invoke_scheduler"):
@@ -188,19 +306,21 @@ class Worker:
                         decorrelate=True,
                         decorrelate_salt=self.id,
                         overflow=32,
+                        used_override=used_override,
                     )
-                from ..device.score import repair_batch_conflicts
+                    from ..device.score import repair_batch_conflicts
 
-                lane_ok = repair_batch_conflicts(
-                    ct,
-                    all_asks,
-                    results,
-                    algorithm_spread=kernel.algorithm_spread,
-                    # multi-TG evals span lanes; a failed lane discards
-                    # the WHOLE eval, so repair must release (and stop
-                    # reserving for) every sibling lane too
-                    lane_groups=lane_groups,
-                )
+                    lane_ok = repair_batch_conflicts(
+                        ct,
+                        all_asks,
+                        results,
+                        algorithm_spread=kernel.algorithm_spread,
+                        # multi-TG evals span lanes; a failed lane
+                        # discards the WHOLE eval, so repair must release
+                        # (and stop reserving for) every sibling lane too
+                        lane_groups=lane_groups,
+                        used_override=used_override,
+                    )
             except Exception:
                 # shared pass failed — every prepared eval falls back to
                 # the individual path rather than dying unacked
@@ -208,46 +328,101 @@ class Worker:
                 metrics.incr("nomad.worker.batch_kernel_errors")
                 singles.extend((ev, token) for ev, token, _, _ in prepared)
                 prepared = []
+                results = None
 
-        off = 0
-        for ev, token, sched, n in prepared:
-            span = results[off : off + n]
-            span_ok = all(lane_ok[off : off + n])
-            off += n
-            if not span_ok:
-                # a conflicted placement had no usable overflow candidate
-                metrics.incr("nomad.worker.batch_conflict_fallbacks")
-                metrics.incr("nomad.worker.batch_repair_fallbacks")
-                singles.append((ev, token))
-                continue
-            self._eval_token = token
-            try:
-                if sched.complete_batch_attempt(span):
-                    self.server.eval_broker.ack(ev.id, token)
-                    self.stats["acked"] += 1
-                    self.stats["processed"] += 1
-                    metrics.incr("nomad.worker.batch_evals_completed")
-                    metrics.incr("nomad.worker.evals_processed")
-                else:
-                    # optimistic conflict: re-run individually on fresh state
+        # accumulate THIS pass's submitted placements into the epoch
+        # delta for the next pass's optimistic base
+        if results is not None and prepared:
+            if self._epoch_used is None:
+                # epoch starts now: freeze the usage this pass scored
+                # against (a fresh epoch always scores on bare ct.used)
+                self._epoch_used = np.asarray(ct.used).copy()
+                self._epoch_delta = np.zeros_like(self._epoch_used)
+                self._epoch_layout_gen = ct.layout_gen
+            delta = self._epoch_delta
+            off = 0
+            for _ev, _tok, _sched, n in prepared:
+                span_ok = all(lane_ok[off : off + n])
+                for lane in range(off, off + n):
+                    if not span_ok:
+                        continue
+                    a = all_asks[lane]
+                    rows = results[lane].node_rows
+                    rows = rows[rows >= 0]
+                    if rows.size:
+                        np.add.at(delta, rows, a.ask)
+                off += n
+
+        # pipeline: the previous commit must finish before this pass's
+        # commit starts (plan order per job; one in-flight commit bounds
+        # memory), but the NEXT device pass overlaps THIS commit.
+        self._join_commit()
+        args = (prepared, all_asks, results, lane_ok, singles)
+        self._commit_thread = threading.Thread(
+            target=self._commit_batch, args=args,
+            name=f"worker-{self.id}-commit", daemon=True,
+        )
+        self._commit_thread.start()
+
+    def _commit_batch(
+        self, prepared, all_asks, results, lane_ok, singles
+    ) -> None:
+        """Commit one finished pass: per-eval plan submission + ack/nack.
+        Runs on the commit thread while the worker's next device pass is
+        in flight."""
+        try:
+            off = 0
+            for ev, token, sched, n in prepared:
+                span = results[off : off + n]
+                span_ok = all(lane_ok[off : off + n])
+                off += n
+                if not span_ok:
+                    # a conflicted placement had no usable overflow
+                    # candidate
                     metrics.incr("nomad.worker.batch_conflict_fallbacks")
-                    metrics.incr("nomad.worker.batch_commit_fallbacks")
+                    metrics.incr("nomad.worker.batch_repair_fallbacks")
                     singles.append((ev, token))
-            except Exception:
-                log.exception("worker %d: batch complete %s", self.id, ev.id)
+                    continue
+                try:
+                    if sched.complete_batch_attempt(span):
+                        self.server.eval_broker.ack(ev.id, token)
+                        self._bump("acked", "processed")
+                        metrics.incr("nomad.worker.batch_evals_completed")
+                        metrics.incr("nomad.worker.evals_processed")
+                    else:
+                        # optimistic conflict: re-run individually on
+                        # fresh state
+                        metrics.incr("nomad.worker.batch_conflict_fallbacks")
+                        metrics.incr("nomad.worker.batch_commit_fallbacks")
+                        singles.append((ev, token))
+                except Exception:
+                    log.exception(
+                        "worker %d: batch complete %s", self.id, ev.id
+                    )
+                    try:
+                        self.server.eval_broker.nack(ev.id, token)
+                    except ValueError:
+                        pass
+                    self._bump("nacked", "processed")
+                    metrics.incr("nomad.worker.evals_processed")
+
+            for ev, token in singles:
+                metrics.incr("nomad.worker.batch_single_fallbacks")
+                self._run_one(ev, token)
+        except Exception:
+            # the commit thread must never die with evals unacked —
+            # including the singles that accumulated from fallbacks
+            log.exception("worker %d: commit thread failed", self.id)
+            outstanding = [
+                (ev, token) for ev, token, _s, _n in prepared
+            ] + list(singles)
+            for ev, token in outstanding:
                 try:
                     self.server.eval_broker.nack(ev.id, token)
-                except ValueError:
+                except Exception:  # noqa: BLE001 — best-effort cleanup
                     pass
-                self.stats["nacked"] += 1
-                self.stats["processed"] += 1
-                metrics.incr("nomad.worker.evals_processed")
 
-        for ev, token in singles:
-            metrics.incr("nomad.worker.batch_single_fallbacks")
-            self._run_one(ev, token)
-
-    def process_eval(self, ev: Evaluation) -> None:
+    def process_eval(self, ev: Evaluation, planner=None) -> None:
         # raft catch-up barrier (worker.go:536-549)
         with metrics.timer("nomad.worker.wait_for_index"):
             self.server.store.wait_for_index(ev.modify_index, timeout=5.0)
@@ -255,23 +430,19 @@ class Worker:
         # all workers share the server's resident device-state cache —
         # tensors refresh incrementally by state index, not per eval
         sched = new_scheduler(
-            ev.type, snapshot, self, cache=self.server.device_cache
+            ev.type,
+            snapshot,
+            planner if planner is not None else _TokenPlanner(self, ""),
+            cache=self.server.device_cache,
         )
         with metrics.timer("nomad.worker.invoke_scheduler"):
             sched.process(ev)
 
-    # -- Planner interface (worker.go:585-767) -----------------------------
+    # -- Planner interface kept for direct (non-batch) callers -------------
     def submit_plan(self, plan: Plan):
-        plan.eval_token = self._eval_token
-        plan.normalize()
-        with metrics.timer("nomad.worker.submit_plan"):
-            future = self.server.plan_queue.enqueue(plan)
-            result = future.result(timeout=30)
-        new_snapshot = None
-        if result.refresh_index:
-            self.server.store.wait_for_index(result.refresh_index, timeout=5.0)
-            new_snapshot = self.server.store.snapshot()
-        return result, new_snapshot
+        return _TokenPlanner(self, getattr(plan, "eval_token", "")).submit_plan(
+            plan
+        )
 
     def update_eval(self, ev: Evaluation) -> None:
         self.server.apply_eval_update([ev])

@@ -656,6 +656,14 @@ class StateStore(StateSnapshot):
                 a.client_status = upd.client_status
                 a.client_description = upd.client_description
                 a.task_states = upd.task_states or a.task_states
+                # client-side health verdict (allochealth tracker): the
+                # deployment watcher consumes it for canary gating; the
+                # first verdict wins (tracker.go never flips a verdict)
+                if upd.deployment_status is not None and (
+                    existing.deployment_status is None
+                    or existing.deployment_status.healthy is None
+                ):
+                    a.deployment_status = upd.deployment_status
                 a.modify_index = index
                 table[a.id] = a
                 if a.node_id:

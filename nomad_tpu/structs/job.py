@@ -171,6 +171,33 @@ class LogConfig:
 
 
 @dataclass(slots=True)
+class ServiceCheck:
+    """One health check on a service. Reference: structs.ServiceCheck
+    (consumed by client/allochealth via the check watcher; the reference
+    registers these in Consul — this build evaluates them client-side)."""
+
+    name: str = ""
+    type: str = "tcp"  # tcp | http | script
+    path: str = "/"  # http only
+    port: int = 0  # literal port (the reference resolves port labels)
+    address: str = "127.0.0.1"
+    command: str = ""  # script only
+    args: list = field(default_factory=list)
+    interval_s: float = 1.0
+    timeout_s: float = 2.0
+
+
+@dataclass(slots=True)
+class Service:
+    """A service advertised by a task. Reference: structs.Service —
+    trimmed to the health-check role (no Consul registration)."""
+
+    name: str = ""
+    port: int = 0
+    checks: list = field(default_factory=list)  # [ServiceCheck]
+
+
+@dataclass(slots=True)
 class Task:
     """One process under a driver. Reference: structs.Task."""
 
@@ -193,6 +220,8 @@ class Task:
     log_config: LogConfig = field(default_factory=LogConfig)
     # volume name → structs.volumes.VolumeMount
     volume_mounts: list = field(default_factory=list)
+    # advertised services with health checks (structs.Task.Services)
+    services: list = field(default_factory=list)
 
 
 @dataclass(slots=True)

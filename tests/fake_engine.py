@@ -1,0 +1,267 @@
+"""A miniature Docker-Engine-API daemon for container-driver tests.
+
+Serves the handful of endpoints nomad_tpu.client.container uses over a
+unix socket, backing each "container" with a REAL subprocess — so wait
+blocks on a real exit, stop delivers real signals, exit codes are real,
+and the daemon (this process's thread) outliving a driver/plugin restart
+exercises true reattach-by-container-id semantics, exactly the role the
+dockerd/podman daemon plays for the reference's docker driver."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import socketserver
+import subprocess
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler
+from urllib.parse import parse_qs, urlparse
+
+
+class _Container:
+    def __init__(self, cid: str, spec: dict):
+        self.id = cid
+        self.spec = spec
+        self.proc: subprocess.Popen | None = None
+        self.exit_code: int | None = None
+        self.stdout = b""
+        self.stderr = b""
+        self.lock = threading.Lock()
+
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def reap(self) -> None:
+        if self.proc is not None and self.proc.poll() is not None and (
+            self.exit_code is None
+        ):
+            out, err = self.proc.communicate()
+            self.stdout += out or b""
+            self.stderr += err or b""
+            self.exit_code = self.proc.returncode
+
+
+class FakeEngine:
+    def __init__(self, sock_path: str):
+        self.sock_path = sock_path
+        self.containers: dict[str, _Container] = {}
+        self.pulled: list[str] = []
+        self.lock = threading.Lock()
+        engine = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, obj=None, raw: bytes = b""):
+                body = (
+                    json.dumps(obj).encode()
+                    if obj is not None
+                    else raw
+                )
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _container(self, cid):
+                with engine.lock:
+                    return engine.containers.get(cid)
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                parts = u.path.strip("/").split("/")
+                if u.path == "/version":
+                    return self._send(200, {"Version": "fake-engine-1.0"})
+                if (
+                    len(parts) == 3
+                    and parts[0] == "containers"
+                    and parts[2] == "json"
+                ):
+                    c = self._container(parts[1])
+                    if c is None:
+                        return self._send(
+                            404, {"message": "no such container"}
+                        )
+                    c.reap()
+                    return self._send(
+                        200,
+                        {
+                            "Id": c.id,
+                            "State": {
+                                "Running": c.running(),
+                                "ExitCode": c.exit_code or 0,
+                            },
+                        },
+                    )
+                if (
+                    len(parts) == 3
+                    and parts[0] == "containers"
+                    and parts[2] == "logs"
+                ):
+                    c = self._container(parts[1])
+                    if c is None:
+                        return self._send(
+                            404, {"message": "no such container"}
+                        )
+                    c.reap()
+                    q = parse_qs(u.query)
+                    data = (
+                        c.stderr if q.get("stderr") == ["1"] else c.stdout
+                    )
+                    return self._send(200, raw=data)
+                return self._send(404, {"message": "not found"})
+
+            def do_POST(self):
+                u = urlparse(self.path)
+                parts = u.path.strip("/").split("/")
+                if parts[0] == "images" and parts[1] == "create":
+                    q = parse_qs(u.query)
+                    engine.pulled.append(q.get("fromImage", [""])[0])
+                    return self._send(200, raw=b"{}")
+                if parts[0] == "containers" and parts[1] == "create":
+                    spec = self._body()
+                    cid = uuid.uuid4().hex
+                    with engine.lock:
+                        engine.containers[cid] = _Container(cid, spec)
+                    return self._send(201, {"Id": cid})
+                if len(parts) == 3 and parts[0] == "containers":
+                    c = self._container(parts[1])
+                    if c is None:
+                        return self._send(
+                            404, {"message": "no such container"}
+                        )
+                    if parts[2] == "start":
+                        return self._start(c)
+                    if parts[2] == "wait":
+                        return self._wait(c)
+                    if parts[2] == "stop":
+                        q = parse_qs(u.query)
+                        t = float(q.get("t", ["5"])[0])
+                        return self._stop(c, t)
+                return self._send(404, {"message": "not found"})
+
+            def do_DELETE(self):
+                u = urlparse(self.path)
+                parts = u.path.strip("/").split("/")
+                if len(parts) == 2 and parts[0] == "containers":
+                    with engine.lock:
+                        c = engine.containers.pop(parts[1], None)
+                    if c is None:
+                        return self._send(
+                            404, {"message": "no such container"}
+                        )
+                    if c.running():
+                        try:
+                            os.killpg(c.proc.pid, signal.SIGKILL)
+                        except (ProcessLookupError, PermissionError):
+                            pass
+                    return self._send(204)
+                return self._send(404, {"message": "not found"})
+
+            # -- container ops -------------------------------------------
+            def _start(self, c: _Container):
+                with c.lock:
+                    if c.proc is not None:
+                        return self._send(
+                            304, {"message": "already started"}
+                        )
+                    cmd = c.spec.get("Cmd") or ["true"]
+                    binds = (c.spec.get("HostConfig") or {}).get(
+                        "Binds"
+                    ) or []
+                    cwd = binds[0].split(":")[0] if binds else None
+                    env = dict(
+                        kv.split("=", 1)
+                        for kv in (c.spec.get("Env") or [])
+                        if "=" in kv
+                    )
+                    try:
+                        c.proc = subprocess.Popen(
+                            cmd,
+                            cwd=cwd,
+                            env={**os.environ, **env},
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE,
+                            start_new_session=True,
+                        )
+                    except OSError as e:
+                        return self._send(400, {"message": str(e)})
+                return self._send(204)
+
+            def _wait(self, c: _Container):
+                if c.proc is None:
+                    return self._send(200, {"StatusCode": 0})
+                c.proc.wait()
+                c.reap()
+                return self._send(200, {"StatusCode": c.exit_code or 0})
+
+            def _stop(self, c: _Container, grace: float):
+                if c.running():
+                    try:
+                        os.killpg(c.proc.pid, signal.SIGTERM)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                    deadline = time.time() + grace
+                    while c.running() and time.time() < deadline:
+                        time.sleep(0.05)
+                    if c.running():
+                        try:
+                            os.killpg(c.proc.pid, signal.SIGKILL)
+                        except (ProcessLookupError, PermissionError):
+                            pass
+                        c.proc.wait()
+                c.reap()
+                return self._send(204)
+
+        class Server(socketserver.ThreadingMixIn, socketserver.TCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+            address_family = socket.AF_UNIX
+
+            def handle_error(self, request, client_address):
+                pass  # client disconnects mid-request are routine
+
+            def server_bind(self):
+                try:
+                    os.unlink(sock_path)
+                except OSError:
+                    pass
+                self.socket.bind(sock_path)
+
+            def server_activate(self):
+                self.socket.listen(16)
+
+        self._server = Server(sock_path, Handler, bind_and_activate=True)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        for c in self.containers.values():
+            if c.running():
+                try:
+                    os.killpg(c.proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass

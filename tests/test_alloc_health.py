@@ -1,0 +1,308 @@
+"""Task-health-gated deployments (client/allochealth analog): check
+evaluation, the health tracker's continuous-window semantics, and
+end-to-end canary gating — a failing check auto-reverts, a flapping task
+never passes the window, a passing check auto-promotes."""
+
+import copy
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.client.allochealth import (
+    AllocHealthTracker,
+    evaluate_check,
+    group_checks,
+)
+from nomad_tpu.structs import Service, ServiceCheck
+from nomad_tpu.structs.job import UpdateStrategy
+
+
+def wait_until(cond, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def listener():
+    """A live TCP listener the tests point checks at."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(8)
+    port = s.getsockname()[1]
+
+    def drain():
+        while True:
+            try:
+                conn, _ = s.accept()
+                conn.close()
+            except OSError:
+                return
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    yield port
+    s.close()
+
+
+class TestEvaluateCheck:
+    def test_tcp_pass_and_fail(self, listener):
+        ok = ServiceCheck(type="tcp", port=listener, timeout_s=1.0)
+        assert evaluate_check(ok) is True
+        bad = ServiceCheck(type="tcp", port=free_port(), timeout_s=0.3)
+        assert evaluate_check(bad) is False
+
+    def test_http_pass_and_fail(self):
+        import http.server
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                code = 200 if self.path == "/health" else 500
+                self.send_response(code)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        port = srv.server_address[1]
+        try:
+            assert evaluate_check(
+                ServiceCheck(type="http", port=port, path="/health")
+            )
+            assert not evaluate_check(
+                ServiceCheck(type="http", port=port, path="/broken")
+            )
+        finally:
+            srv.shutdown()
+
+    def test_script_check(self):
+        assert evaluate_check(
+            ServiceCheck(type="script", command="/bin/true")
+        )
+        assert not evaluate_check(
+            ServiceCheck(type="script", command="/bin/false")
+        )
+
+
+# -- tracker unit tests ------------------------------------------------------
+
+
+@dataclass
+class _FakeState:
+    state: str = "running"
+    failed: bool = False
+    restarts: int = 0
+
+
+@dataclass
+class _FakeRunner:
+    alloc: object = None
+    task_states: dict = field(default_factory=dict)
+
+
+def make_runner(check=None, deployment_id="dep-1"):
+    job = mock.job()
+    task = job.task_groups[0].tasks[0]
+    if check is not None:
+        task.services = [Service(name="web", checks=[check])]
+    alloc = mock.alloc(job=job)
+    alloc.deployment_id = deployment_id
+    alloc.task_group = job.task_groups[0].name
+    return _FakeRunner(
+        alloc=alloc, task_states={task.name: _FakeState()}
+    )
+
+
+class TestTracker:
+    def test_healthy_after_continuous_window(self, listener):
+        runner = make_runner(
+            ServiceCheck(type="tcp", port=listener, interval_s=0.1)
+        )
+        got = []
+        t = AllocHealthTracker(
+            runner, None, on_health=lambda aid, h: got.append(h),
+            min_healthy_time_s=0.4, healthy_deadline_s=5.0,
+        )
+        t.start()
+        t.join(timeout=5)
+        assert got == [True]
+
+    def test_failing_check_unhealthy_at_deadline(self):
+        runner = make_runner(
+            ServiceCheck(type="tcp", port=free_port(), interval_s=0.1,
+                         timeout_s=0.2)
+        )
+        got = []
+        t = AllocHealthTracker(
+            runner, None, on_health=lambda aid, h: got.append(h),
+            min_healthy_time_s=0.2, healthy_deadline_s=1.0,
+        )
+        t.start()
+        t.join(timeout=8)
+        assert got == [False]
+
+    def test_flapping_task_never_healthy(self, listener):
+        """Checks pass, but the task restarts faster than the window —
+        the tracker resets the clock each restart and reports unhealthy
+        at the deadline (the reference tracker's restart handling)."""
+        runner = make_runner(
+            ServiceCheck(type="tcp", port=listener, interval_s=0.1)
+        )
+        state = next(iter(runner.task_states.values()))
+        stop = threading.Event()
+
+        def flap():
+            while not stop.is_set():
+                state.restarts += 1
+                time.sleep(0.3)
+
+        threading.Thread(target=flap, daemon=True).start()
+        got = []
+        t = AllocHealthTracker(
+            runner, None, on_health=lambda aid, h: got.append(h),
+            min_healthy_time_s=1.0, healthy_deadline_s=2.5,
+        )
+        t.start()
+        t.join(timeout=10)
+        stop.set()
+        assert got == [False]
+
+    def test_dead_task_unhealthy_immediately(self):
+        runner = make_runner(
+            ServiceCheck(type="tcp", port=free_port())
+        )
+        st = next(iter(runner.task_states.values()))
+        st.state = "dead"
+        st.failed = True
+        got = []
+        t = AllocHealthTracker(
+            runner, None, on_health=lambda aid, h: got.append(h),
+            min_healthy_time_s=5.0, healthy_deadline_s=30.0,
+        )
+        t.start()
+        t.join(timeout=5)
+        assert got == [False]
+
+
+# -- end-to-end canary gating ------------------------------------------------
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    a = DevAgent(data_dir=str(tmp_path), num_workers=1)
+    a.server.config.deployment_watch_interval = 0.05
+    a.server.deployment_watcher.interval = 0.05
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def checked_job(port, count=2, **update_kw):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].driver = "mock_driver"
+    tg.tasks[0].config = {"run_for": 600}
+    tg.tasks[0].resources.cpu = 100
+    tg.tasks[0].resources.memory_mb = 64
+    tg.tasks[0].services = [
+        Service(
+            name="web",
+            checks=[
+                ServiceCheck(
+                    type="tcp", port=port, interval_s=0.1, timeout_s=0.3
+                )
+            ],
+        )
+    ]
+    defaults = dict(
+        max_parallel=1, min_healthy_time_s=0.3, healthy_deadline_s=3.0
+    )
+    defaults.update(update_kw)
+    tg.update = UpdateStrategy(**defaults)
+    return job
+
+
+def live(agent, job):
+    return [
+        a
+        for a in agent.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+
+
+class TestCheckGatedDeployments:
+    def test_passing_check_promotes_canary(self, agent, listener):
+        job = checked_job(
+            listener, canary=1, auto_promote=True, auto_revert=True
+        )
+        # version 0 deploys from scratch (no canary on first rollout)
+        agent.register_job(job)
+        assert wait_until(lambda: len(live(agent, job)) == 2, timeout=30)
+
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].config = {"run_for": 601}
+        agent.register_job(j2)
+
+        def promoted():
+            d = agent.store.latest_deployment_by_job(
+                job.namespace, job.id
+            )
+            return d is not None and d.status == "successful"
+
+        assert wait_until(promoted, timeout=30), (
+            "healthy canary (passing check) should auto-promote and the "
+            "deployment complete"
+        )
+
+    def test_failing_check_auto_reverts(self, agent, listener):
+        job = checked_job(listener, auto_revert=True)
+        agent.register_job(job)
+        assert wait_until(lambda: len(live(agent, job)) == 2, timeout=30)
+        v_good = agent.store.job_by_id(job.namespace, job.id).version
+
+        # new version: the task RUNS (never crashes) but its check
+        # targets a closed port — "running" alone must not pass the gate
+        j2 = copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].config = {"run_for": 602}
+        j2.task_groups[0].tasks[0].services[0].checks[0].port = free_port()
+        agent.register_job(j2)
+
+        def reverted():
+            cur = agent.store.job_by_id(job.namespace, job.id)
+            return (
+                cur.version > j2.version
+                and cur.task_groups[0].tasks[0].config.get("run_for")
+                == 600
+            )
+
+        assert wait_until(reverted, timeout=40), (
+            "unhealthy canary (failing check on a running task) should "
+            "fail the deployment and auto-revert"
+        )
+        failed = [
+            d
+            for d in agent.store.deployments()
+            if d.job_id == job.id and d.status == "failed"
+        ]
+        assert failed
+        _ = v_good

@@ -1,0 +1,167 @@
+"""Cgroup confinement by the native executor (native/executor.cpp — the
+drivers/shared/executor libcontainer-cgroup analog): per-task cgroup with
+memory / pids / cpu limits, kill-by-cgroup, and cleanup. Skipped on hosts
+where this process cannot create cgroups."""
+
+import os
+import subprocess
+import time
+
+import pytest
+
+from nomad_tpu.client.drivers import ExecDriver, native_executor
+from nomad_tpu.structs import Task
+
+
+def cgroups_writable() -> bool:
+    return ExecDriver._cgroups_available()
+
+
+pytestmark = pytest.mark.skipif(
+    not cgroups_writable() or native_executor() is None,
+    reason="needs writable cgroups and the native executor",
+)
+
+
+def sh_task(name, script, cpu=500, memory_mb=64):
+    t = Task(
+        name=name,
+        driver="exec",
+        config={"command": "/bin/sh", "args": ["-c", script]},
+    )
+    t.resources.cpu = cpu
+    t.resources.memory_mb = memory_mb
+    return t
+
+
+def find_task_cgroup(handle_id: str):
+    """The executor names the cgroup after the handle id prefix."""
+    name = f"nomad-{handle_id[:18]}"
+    for base in (
+        "/sys/fs/cgroup",
+        "/sys/fs/cgroup/memory",
+        "/sys/fs/cgroup/pids",
+    ):
+        p = os.path.join(base, name)
+        if os.path.isdir(p):
+            return p
+    return None
+
+
+def wait_for_cgroup(handle_id: str, timeout=5.0):
+    """The supervisor creates the cgroup a few ms after start() returns."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        p = find_task_cgroup(handle_id)
+        if p is not None:
+            return p
+        time.sleep(0.05)
+    return None
+
+
+class TestCgroupExecutor:
+    def test_task_runs_inside_cgroup(self, tmp_path):
+        d = ExecDriver()
+        h = d.start(
+            sh_task("cg", "cat /proc/self/cgroup; sleep 0.5"),
+            {},
+            str(tmp_path),
+        )
+        # while running, the cgroup dir exists and holds the task
+        assert (
+            wait_for_cgroup(h.id) is not None
+        ), "task cgroup was not created"
+        assert d.wait(h, timeout=10) == 0
+        out = (tmp_path / "cg.stdout").read_text()
+        assert f"nomad-{h.id[:18]}" in out, out
+        # and it is removed after exit
+        time.sleep(0.3)
+        assert find_task_cgroup(h.id) is None
+
+    def test_fork_bomb_contained_by_pids_limit(self, tmp_path):
+        """A runaway forker is stopped by pids.max (NOT by RLIMIT_NPROC,
+        which counts per-uid across the whole host and as root is
+        useless): the task fails or stalls, the host stays healthy, and
+        stop() reaps every descendant via the cgroup."""
+        d = ExecDriver()
+        h = d.start(
+            sh_task(
+                "bomb",
+                # try to spawn 600 concurrent sleepers (> pids.max 512);
+                # keep the task alive afterwards so the cgroup is
+                # observable even if every fork failed fast (under suite
+                # load RLIMIT_NPROC can be exhausted host-wide, so the
+                # keepalive must not need a fork: exec replaces the
+                # shell; the counter loop uses only builtins)
+                "i=0; while [ $i -lt 600 ]; do sleep 30 & i=$((i+1)); "
+                "done; exec sleep 30",
+            ),
+            {},
+            str(tmp_path),
+        )
+        cg = wait_for_cgroup(h.id)
+        assert cg is not None
+        time.sleep(1.0)
+        # under host-wide RLIMIT_NPROC pressure the whole task may die
+        # fast and the supervisor cleans the cgroup — containment is then
+        # trivially satisfied; only assert the count while it exists
+        try:
+            procs_file = os.path.join(cg, "cgroup.procs")
+            if not os.path.exists(procs_file):
+                procs_file = os.path.join(cg, "tasks")
+            with open(procs_file) as f:
+                n_procs = len(f.read().split())
+            assert n_procs <= 513, f"cgroup held {n_procs} procs"
+        except FileNotFoundError:
+            pass
+        d.stop(h, kill_timeout=1.0)
+        # every descendant dead: the cgroup drains and is removed
+        deadline = time.time() + 10
+        while time.time() < deadline and find_task_cgroup(h.id):
+            time.sleep(0.2)
+        assert find_task_cgroup(h.id) is None, "cgroup not cleaned up"
+
+    def test_oom_contained_by_memory_limit(self, tmp_path):
+        """A task allocating past its memory ask is killed by the
+        cgroup's limit, not by exhausting the host."""
+        d = ExecDriver()
+        h = d.start(
+            sh_task(
+                "oom",
+                # python grabs ~256MB against a 64MB cgroup
+                "exec %s -c \"x = bytearray(256 * 1024 * 1024); print('survived')\""
+                % os.environ.get("PYTHON", "python3"),
+                memory_mb=64,
+            ),
+            {},
+            str(tmp_path),
+        )
+        code = d.wait(h, timeout=30)
+        out = (tmp_path / "oom.stdout").read_text()
+        assert "survived" not in out
+        assert code != 0  # OOM-killed (137) or MemoryError exit
+
+    def test_cpu_quota_applied(self, tmp_path):
+        d = ExecDriver()
+        h = d.start(
+            sh_task("cpu", "sleep 0.5", cpu=500), {}, str(tmp_path)
+        )
+        cg = wait_for_cgroup(h.id)
+        assert cg is not None
+        if os.path.exists(os.path.join(cg, "cpu.max")):
+            quota, period = (
+                open(os.path.join(cg, "cpu.max")).read().split()
+            )
+            assert int(quota) == 500 * 100 and int(period) == 100000
+        else:
+            cpu_cg = os.path.join(
+                "/sys/fs/cgroup/cpu", f"nomad-{h.id[:18]}"
+            )
+            if os.path.isdir(cpu_cg):
+                q = int(
+                    open(
+                        os.path.join(cpu_cg, "cpu.cfs_quota_us")
+                    ).read()
+                )
+                assert q == 500 * 100
+        assert d.wait(h, timeout=10) == 0

@@ -1,0 +1,184 @@
+"""Container driver (client/container.py — the drivers/docker analog)
+against the fake Engine daemon: full lifecycle, real exit codes, log
+capture, reattach-by-container-id through driver AND plugin restart, and
+the out-of-process plugin protocol path."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu.client.container import ContainerDriver
+from nomad_tpu.client.drivers import DriverError, TASK_STATE_DEAD
+from nomad_tpu.client.plugin import PluginDriverClient
+from nomad_tpu.structs import Task
+
+from fake_engine import FakeEngine
+
+
+@pytest.fixture()
+def engine(tmp_path):
+    sock = str(tmp_path / "engine.sock")
+    e = FakeEngine(sock).start()
+    old = os.environ.get("NOMAD_CONTAINER_SOCK")
+    os.environ["NOMAD_CONTAINER_SOCK"] = sock
+    yield e
+    if old is None:
+        os.environ.pop("NOMAD_CONTAINER_SOCK", None)
+    else:
+        os.environ["NOMAD_CONTAINER_SOCK"] = old
+    e.stop()
+
+
+def ctask(name, script, image="busybox:latest", **res):
+    t = Task(
+        name=name,
+        driver="container",
+        config={
+            "image": image,
+            "command": "/bin/sh",
+            "args": ["-c", script],
+        },
+    )
+    for k, v in res.items():
+        setattr(t.resources, k, v)
+    return t
+
+
+class TestContainerLifecycle:
+    def test_fingerprint_requires_daemon(self, tmp_path):
+        d = ContainerDriver(sock_path=str(tmp_path / "missing.sock"))
+        assert d.fingerprint() is False
+
+    def test_fingerprint_with_daemon(self, engine):
+        assert ContainerDriver(engine.sock_path).fingerprint() is True
+
+    def test_start_wait_exit_code_and_logs(self, engine, tmp_path):
+        d = ContainerDriver(engine.sock_path)
+        h = d.start(
+            ctask("web", "echo out-line; echo err-line >&2; exit 4"),
+            {"FOO": "bar"},
+            str(tmp_path),
+        )
+        assert h.id in engine.containers
+        code = d.wait(h, timeout=10)
+        assert code == 4
+        assert h.state == TASK_STATE_DEAD
+        # image pull was requested, resources plumbed through
+        assert engine.pulled == ["busybox:latest"]
+        # daemon-held logs drained into the task dir (fs endpoint parity)
+        assert b"out-line" in (tmp_path / "web.stdout").read_bytes()
+        assert b"err-line" in (tmp_path / "web.stderr").read_bytes()
+
+    def test_env_and_binds(self, engine, tmp_path):
+        d = ContainerDriver(engine.sock_path)
+        h = d.start(
+            ctask("envt", 'echo "$GREETING" > marker.txt'),
+            {"GREETING": "hello-container"},
+            str(tmp_path),
+        )
+        assert d.wait(h, timeout=10) == 0
+        # the fake engine runs Cmd with cwd = host side of the bind
+        assert (
+            "hello-container"
+            in (tmp_path / "marker.txt").read_text()
+        )
+
+    def test_resources_map_to_host_config(self, engine, tmp_path):
+        d = ContainerDriver(engine.sock_path)
+        h = d.start(
+            ctask("res", "exit 0", cpu=500, memory_mb=256),
+            {},
+            str(tmp_path),
+        )
+        spec = engine.containers[h.id].spec
+        assert spec["HostConfig"]["Memory"] == 256 * 1024 * 1024
+        assert spec["HostConfig"]["NanoCpus"] == int(500 * 1e6)
+        d.wait(h, timeout=10)
+
+    def test_stop_terminates_and_removes(self, engine, tmp_path):
+        d = ContainerDriver(engine.sock_path)
+        h = d.start(ctask("long", "sleep 60"), {}, str(tmp_path))
+        t0 = time.time()
+        d.stop(h, kill_timeout=1.0)
+        assert time.time() - t0 < 10
+        assert h.state == TASK_STATE_DEAD
+        assert h.id not in engine.containers  # removed
+
+    def test_missing_image_config_rejected(self, engine, tmp_path):
+        d = ContainerDriver(engine.sock_path)
+        t = Task(name="x", driver="container", config={})
+        with pytest.raises(DriverError):
+            d.start(t, {}, str(tmp_path))
+
+
+class TestContainerReattach:
+    def test_recover_running_container(self, engine, tmp_path):
+        d = ContainerDriver(engine.sock_path)
+        h = d.start(
+            ctask("survivor", "sleep 2; exit 9"), {}, str(tmp_path)
+        )
+        # client restart: a brand-new driver instance, same handle
+        d2 = ContainerDriver(engine.sock_path)
+        assert d2.recover(h) is True
+        assert d2.wait(h, timeout=10) == 9
+
+    def test_recover_exited_container_real_exit_code(
+        self, engine, tmp_path
+    ):
+        """An exit that happened while the client was down still yields
+        its REAL code — the daemon owns the status (the role the C++
+        supervisor plays for exec tasks)."""
+        d = ContainerDriver(engine.sock_path)
+        h = d.start(ctask("gone", "exit 6"), {}, str(tmp_path))
+        engine.containers[h.id].proc.wait()
+        d2 = ContainerDriver(engine.sock_path)
+        assert d2.recover(h) is True
+        assert h.exit_code == 6
+        assert h.state == TASK_STATE_DEAD
+
+    def test_recover_unknown_container(self, engine, tmp_path):
+        from nomad_tpu.client.drivers import TaskHandle
+
+        d = ContainerDriver(engine.sock_path)
+        assert (
+            d.recover(TaskHandle(id="deadbeef", driver="container"))
+            is False
+        )
+
+
+class TestContainerThroughPlugin:
+    """The out-of-process path: `python -m nomad_tpu.client.plugin
+    container` — driver.proto-style lifecycle over NDJSON stdio, incl.
+    reattach through plugin death (the container daemon outlives it)."""
+
+    def test_lifecycle_through_plugin(self, engine, tmp_path):
+        d = PluginDriverClient("container")
+        try:
+            assert d.fingerprint()
+            h = d.start(
+                ctask("pweb", "echo from-plugin; exit 5"),
+                {},
+                str(tmp_path),
+            )
+            assert d.wait(h, timeout=15) == 5
+            assert b"from-plugin" in (
+                tmp_path / "pweb.stdout"
+            ).read_bytes()
+        finally:
+            d.close()
+
+    def test_reattach_through_plugin_death(self, engine, tmp_path):
+        d = PluginDriverClient("container")
+        try:
+            h = d.start(
+                ctask("pz", "sleep 2; exit 8"), {}, str(tmp_path)
+            )
+            # kill the plugin subprocess; the container keeps running in
+            # the daemon
+            d._proc.kill()
+            d._proc.wait()
+            assert d.recover(h) is True  # respawned plugin re-binds
+            assert d.wait(h, timeout=15) == 8
+        finally:
+            d.close()

@@ -1,0 +1,48 @@
+"""Corpus-level placement-score parity — the BASELINE ≤0.5% clause.
+
+BASELINE.md: "≤0.5% placement-score regression vs the Go binpacker".
+The component vectors (test_rank_vectors.py, test_preemption_vectors.py,
+test_reconcile_vectors.py) pin each scoring term; these tests close the
+corpus gap by dual-running seeded plan streams through the device kernels
+and the reference-faithful stepwise host oracle (device/parity.py) and
+bounding the aggregate normalized-score delta.
+
+Each graded-config shape exercises a different kernel path:
+  config2 → closed-form top-k; config3 → one-per-value chunked
+  (even spread + affinity); config4 → exact scan / chunked
+  (anti-affinity + target spread + distinct caps).
+"""
+
+import pytest
+
+from nomad_tpu.device.parity import run_parity_suite
+
+BAR_PCT = 0.5
+
+
+@pytest.fixture(scope="module")
+def suite():
+    return run_parity_suite(small=True)
+
+
+@pytest.mark.parametrize(
+    "config",
+    ["config2_binpack", "config3_spread_affinity", "config4_antiaffinity_caps"],
+)
+def test_score_delta_within_bar(suite, config):
+    r = suite[config]
+    assert r["placements"] > 0
+    # the clause bounds REGRESSION; a negative delta (device beat
+    # stepwise greedy) also passes
+    assert r["score_delta_pct"] <= BAR_PCT, r
+
+
+@pytest.mark.parametrize(
+    "config",
+    ["config2_binpack", "config3_spread_affinity", "config4_antiaffinity_caps"],
+)
+def test_no_unplaced_divergence(suite, config):
+    """The device path must not fail placements the oracle can make
+    (truncated chunk provisioning would show up here)."""
+    r = suite[config]
+    assert r["failed_device"] == 0, r
