@@ -25,6 +25,7 @@ class DevAgent:
         node=None,
         host_volumes: Optional[dict] = None,
         driver_mode: str = "inprocess",
+        device_plugins: Optional[list] = None,
     ):
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="nomad-tpu-dev-")
         self.server = Server(
@@ -36,6 +37,7 @@ class DevAgent:
             node=node,
             host_volumes=host_volumes,
             driver_mode=driver_mode,
+            device_plugins=device_plugins,
         )
 
     def start(self) -> None:

@@ -8,6 +8,7 @@ operator.go). Stdlib urllib transport; one class per noun, hung off
 from __future__ import annotations
 
 import json
+import os
 import urllib.error
 import urllib.request
 from typing import Any, Optional
@@ -20,9 +21,16 @@ class APIException(Exception):
 
 
 class NomadClient:
-    def __init__(self, address: str = "http://127.0.0.1:4646", timeout: float = 10.0):
+    def __init__(
+        self,
+        address: str = "http://127.0.0.1:4646",
+        timeout: float = 10.0,
+        token: str = "",
+    ):
         self.address = address.rstrip("/")
         self.timeout = timeout
+        # ACL secret (api/api.go SetSecretID; header X-Nomad-Token)
+        self.token = token or os.environ.get("NOMAD_TOKEN", "")
 
     # -- transport ---------------------------------------------------------
     def _request(
@@ -38,11 +46,11 @@ class NomadClient:
 
             url += "?" + urlencode(params)
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["X-Nomad-Token"] = self.token
         req = urllib.request.Request(
-            url,
-            data=data,
-            method=method,
-            headers={"Content-Type": "application/json"},
+            url, data=data, method=method, headers=headers
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:

@@ -136,6 +136,26 @@ class HTTPAgent:
                 self.handle_job_dispatch,
             ),
             (
+                # version history (job_endpoint.go GetJobVersions)
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/versions$"),
+                self.handle_job_versions,
+            ),
+            (
+                # rollback to a prior version (job_endpoint.go Revert)
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/revert$"),
+                self.handle_job_revert,
+            ),
+            (
+                # forced re-evaluation (job_endpoint.go Evaluate)
+                re.compile(r"^/v1/job/(?P<job_id>[^/]+)/evaluate$"),
+                self.handle_job_evaluate,
+            ),
+            (
+                # manual GC sweep (system_endpoint.go GarbageCollect)
+                re.compile(r"^/v1/system/gc$"),
+                self.handle_system_gc,
+            ),
+            (
                 re.compile(r"^/v1/job/(?P<job_id>[^/]+)/periodic/force$"),
                 self.handle_periodic_force,
             ),
@@ -772,6 +792,85 @@ class HTTPAgent:
             self.server.raft_apply(MsgType.SCHED_CONFIG, {"config": new_cfg})
             return {"updated": True}
         raise APIError(405, f"method {method} not allowed")
+
+    def handle_job_versions(self, method, body, query, job_id):
+        """GET /v1/job/:id/versions (job_endpoint.go GetJobVersions)."""
+        ns = query.get("namespace", "default")
+        self._enforce_obj_ns(query, ns, "read-job")
+        versions = self.server.store.job_versions_list(ns, job_id)
+        if not versions:
+            cur = self.server.store.job_by_id(ns, job_id)
+            if cur is None:
+                raise APIError(404, f"job {job_id} not found")
+            versions = [cur]
+        return {
+            "versions": [encode(j) for j in sorted(
+                versions, key=lambda j: -j.version
+            )],
+        }
+
+    def handle_job_revert(self, method, body, query, job_id):
+        """POST /v1/job/:id/revert {"job_version": N} — re-registers the
+        prior version (the rollback is itself a new version, like the
+        reference's Job.Revert)."""
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        ns = query.get("namespace", "default")
+        self._enforce_obj_ns(query, ns, "submit-job")
+        if not body or "job_version" not in body:
+            raise APIError(400, "missing 'job_version'")
+        import copy as _copy
+
+        old = self.server.store.job_version(
+            ns, job_id, int(body["job_version"])
+        )
+        if old is None:
+            raise APIError(
+                404, f"job {job_id} version {body['job_version']} not found"
+            )
+        ev = self.server.register_job(_copy.deepcopy(old))
+        return {"eval_id": getattr(ev, "id", ""), "reverted_to": old.version}
+
+    def handle_job_evaluate(self, method, body, query, job_id):
+        """POST /v1/job/:id/evaluate — force a new evaluation
+        (job_endpoint.go Evaluate)."""
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        ns = query.get("namespace", "default")
+        self._enforce_obj_ns(query, ns, "submit-job")
+        job = self.server.store.job_by_id(ns, job_id)
+        if job is None:
+            raise APIError(404, f"job {job_id} not found")
+        if job.is_periodic() or job.is_parameterized():
+            # templates never get direct evals (job_endpoint.go Evaluate
+            # rejects them; they run via periodic launch / dispatch)
+            raise APIError(
+                400, "can't evaluate periodic/parameterized job"
+            )
+        from ..structs import Evaluation
+        from ..structs.evaluation import EVAL_STATUS_PENDING
+
+        ev = Evaluation(
+            namespace=ns,
+            priority=job.priority,
+            type=job.type,
+            triggered_by="job-eval",
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+        )
+        self.server.apply_eval_create([ev])
+        return {"eval_id": ev.id}
+
+    def handle_system_gc(self, method, body, query):
+        """PUT /v1/system/gc — force one GC sweep
+        (system_endpoint.go GarbageCollect → the _core job path)."""
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "PUT required")
+        self._enforce(query, "operator_write")
+        # the manual sweep waives the age thresholds (the reference's
+        # forced _core GC ignores them too)
+        reaped = self.server.core_gc.gc_all(force=True)
+        return {"reaped": reaped}
 
     def handle_raft_configuration(self, method, body, query):
         """GET /v1/operator/raft/configuration — the voting set

@@ -14,6 +14,7 @@ import itertools
 import threading
 import time
 import uuid
+import zlib
 from typing import Optional
 
 from ..structs import Evaluation
@@ -52,13 +53,22 @@ class EvalBroker:
         nack_delay: float = DEFAULT_NACK_DELAY,
         initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
         delivery_limit: int = EVAL_DELIVERY_LIMIT,
+        n_partitions: int = 1,
     ):
         self._lock = threading.Condition()
         self.enabled = False
         self.nack_delay = nack_delay
         self.initial_nack_delay = initial_nack_delay
         self.delivery_limit = delivery_limit
-        # scheduler type → ready queue
+        # Eval-stream partitioning for CONCURRENT batching workers: each
+        # eval's job hashes onto one of n_partitions sub-queues, and a
+        # batching worker dequeues only its own partition — two batched
+        # passes therefore never carry evals of the same job set, and
+        # with per-worker lane striping (decorrelate_salt) they rarely
+        # share hot nodes. n_partitions=1 keeps the original single
+        # ready-queue-per-type behavior.
+        self.n_partitions = max(1, n_partitions)
+        # scheduler type (or "type#pN" when partitioned) → ready queue
         self._ready: dict[str, _PQ] = {}
         # eval id → (eval, token, deadline) while unacked
         self._unack: dict[str, tuple[Evaluation, str]] = {}
@@ -115,7 +125,7 @@ class EvalBroker:
         if not ignore_job_gate and job_key in self._in_flight_jobs:
             self._pending_by_job.setdefault(job_key, _PQ()).push(ev)
             return
-        self._ready.setdefault(ev.type, _PQ()).push(ev)
+        self._ready.setdefault(self._queue_key(ev), _PQ()).push(ev)
         from ..utils.metrics import global_metrics
 
         global_metrics.set_gauge(
@@ -140,22 +150,53 @@ class EvalBroker:
         return wait
 
     # -- dequeue -----------------------------------------------------------
+    def _queue_key(self, ev: Evaluation) -> str:
+        if self.n_partitions == 1:
+            return ev.type
+        part = zlib.crc32(
+            f"{ev.namespace}/{ev.job_id}".encode()
+        ) % self.n_partitions
+        return f"{ev.type}#p{part}"
+
+    def _scan_keys(
+        self, schedulers: list[str], partition: Optional[int]
+    ) -> list[str]:
+        if self.n_partitions == 1:
+            return list(schedulers)
+        keys = []
+        for t in schedulers:
+            if t == FAILED_QUEUE:
+                keys.append(t)  # the failed queue is never partitioned
+            elif partition is None:
+                keys.extend(
+                    f"{t}#p{p}" for p in range(self.n_partitions)
+                )
+            else:
+                keys.append(f"{t}#p{partition % self.n_partitions}")
+        return keys
+
     def dequeue(
-        self, schedulers: list[str], timeout: Optional[float] = None
+        self,
+        schedulers: list[str],
+        timeout: Optional[float] = None,
+        partition: Optional[int] = None,
     ) -> tuple[Optional[Evaluation], str]:
         """Blocking dequeue for the given scheduler types. Returns
         (eval, token) or (None, "") on timeout/disable. ``timeout=None``
         blocks until an eval arrives (the reference's blocking
         Eval.Dequeue RPC, nomad/eval_broker.go); ``timeout=0`` is an
-        explicit non-blocking poll."""
+        explicit non-blocking poll. ``partition`` restricts the scan to
+        one job-hash partition (concurrent batching workers); None scans
+        every partition."""
         deadline = None if timeout is None else time.time() + timeout
+        keys = self._scan_keys(schedulers, partition)
         with self._lock:
             while True:
                 if not self.enabled:
                     return None, ""
                 next_delay = self._drain_delayed_locked()
                 best: Optional[_PQ] = None
-                for t in schedulers:
+                for t in keys:
                     q = self._ready.get(t)
                     if not q:
                         continue
@@ -194,19 +235,23 @@ class EvalBroker:
                 self._lock.wait(min(remaining, next_delay, 1.0))
 
     def dequeue_many(
-        self, schedulers: list[str], max_n: int, timeout: Optional[float] = None
+        self,
+        schedulers: list[str],
+        max_n: int,
+        timeout: Optional[float] = None,
+        partition: Optional[int] = None,
     ) -> list[tuple[Evaluation, str]]:
         """Dequeue up to ``max_n`` ready evals in one call — the intake of
         the batched multi-eval device pass (SURVEY.md §7 step 5). The
         first eval blocks up to ``timeout``; the rest are taken only if
         immediately ready. Per-job serialization holds: two evals of one
         job can never be in the same batch (or in flight at all)."""
-        first = self.dequeue(schedulers, timeout=timeout)
+        first = self.dequeue(schedulers, timeout=timeout, partition=partition)
         if first[0] is None:
             return []
         out = [first]
         while len(out) < max_n:
-            nxt = self.dequeue(schedulers, timeout=0.0)
+            nxt = self.dequeue(schedulers, timeout=0.0, partition=partition)
             if nxt[0] is None:
                 break
             out.append(nxt)

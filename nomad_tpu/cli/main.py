@@ -21,7 +21,7 @@ DEFAULT_ADDR = os.environ.get("NOMAD_TPU_ADDR", "http://127.0.0.1:4646")
 
 
 def _client(args) -> NomadClient:
-    return NomadClient(args.address)
+    return NomadClient(args.address, token=getattr(args, "token", ""))
 
 
 def _fail(msg: str) -> int:
@@ -471,6 +471,190 @@ def cmd_operator_debug(args) -> int:
     return 0
 
 
+def cmd_job_history(args) -> int:
+    """`nomad job history` (command/job_history.go)."""
+    c = _client(args)
+    out = c._request("GET", f"/v1/job/{args.job_id}/versions")
+    for v in out.get("versions", []):
+        stable = "stable" if v.get("stable") else ""
+        print(
+            f"Version {v.get('version', 0):>3}  "
+            f"priority={v.get('priority', 50)}  {stable}"
+        )
+    return 0
+
+
+def cmd_job_inspect(args) -> int:
+    """`nomad job inspect` (command/job_inspect.go): raw job JSON."""
+    c = _client(args)
+    out = c._request("GET", f"/v1/job/{args.job_id}")
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+def cmd_job_revert(args) -> int:
+    """`nomad job revert <job> <version>` (command/job_revert.go)."""
+    c = _client(args)
+    out = c._request(
+        "POST",
+        f"/v1/job/{args.job_id}/revert",
+        body={"job_version": int(args.version)},
+    )
+    print(
+        f"==> reverted {args.job_id} to version {out['reverted_to']} "
+        f"(eval {out.get('eval_id', '')[:8]})"
+    )
+    return 0
+
+
+def cmd_job_eval(args) -> int:
+    """`nomad job eval` (command/job_eval.go): force a re-evaluation."""
+    c = _client(args)
+    out = c._request("POST", f"/v1/job/{args.job_id}/evaluate")
+    print(f"==> created evaluation {out['eval_id'][:8]}")
+    return 0
+
+
+def cmd_job_dispatch(args) -> int:
+    """`nomad job dispatch` (command/job_dispatch.go)."""
+    c = _client(args)
+    meta = dict(kv.split("=", 1) for kv in (args.meta or []))
+    out = c.jobs.dispatch(
+        args.job_id, payload=(args.payload or "").encode(), meta=meta
+    )
+    print(f"==> dispatched {out.get('dispatched_job_id', '')}")
+    return 0
+
+
+def cmd_job_periodic_force(args) -> int:
+    """`nomad job periodic force` (command/job_periodic_force.go)."""
+    c = _client(args)
+    out = c._request("POST", f"/v1/job/{args.job_id}/periodic/force")
+    print(f"==> forced periodic launch, eval {out.get('eval_id', '')[:8]}")
+    return 0
+
+
+def cmd_eval_list(args) -> int:
+    """`nomad eval list` (command/eval_list.go)."""
+    c = _client(args)
+    evs = c._request("GET", "/v1/evaluations")
+    rows = [("ID", "Priority", "Type", "TriggeredBy", "Job", "Status")]
+    for e in evs[:50]:
+        rows.append((
+            e.get("id", "")[:8], str(e.get("priority", "")),
+            e.get("type", ""), e.get("triggered_by", ""),
+            e.get("job_id", ""), e.get("status", ""),
+        ))
+    w = [max(len(r[i]) for r in rows) for i in range(6)]
+    for r in rows:
+        print("  ".join(v.ljust(x) for v, x in zip(r, w)))
+    return 0
+
+
+def cmd_system_gc(args) -> int:
+    """`nomad system gc` (command/system_gc.go)."""
+    c = _client(args)
+    out = c._request("PUT", "/v1/system/gc")
+    print("==> gc:", json.dumps(out.get("reaped", {})))
+    return 0
+
+
+def cmd_operator_snapshot_save(args) -> int:
+    """`nomad operator snapshot save` (command/operator_snapshot_save.go)."""
+    c = _client(args)
+    out = c._request(
+        "POST", "/v1/operator/snapshot/save", body={"path": args.path}
+    )
+    print(f"==> snapshot at index {out['index']} written to {out['path']}")
+    return 0
+
+
+def cmd_operator_metrics(args) -> int:
+    """`nomad operator metrics` (command/operator_metrics.go)."""
+    c = _client(args)
+    print(json.dumps(c._request("GET", "/v1/metrics"), indent=2))
+    return 0
+
+
+def cmd_scaling_policies(args) -> int:
+    """`nomad scaling policy list` (command/scaling_policy_list.go)."""
+    c = _client(args)
+    print(json.dumps(c._request("GET", "/v1/scaling/policies"), indent=2))
+    return 0
+
+
+def cmd_acl_bootstrap(args) -> int:
+    c = _client(args)
+    out = c._request("POST", "/v1/acl/bootstrap")
+    print(f"Accessor ID = {out['AccessorID']}")
+    print(f"Secret ID   = {out['SecretID']}")
+    return 0
+
+
+def cmd_acl_policy_apply(args) -> int:
+    c = _client(args)
+    rules = open(args.rules_file).read()
+    c._request(
+        "POST", f"/v1/acl/policy/{args.name}", body={"Rules": rules}
+    )
+    print(f"==> wrote policy {args.name}")
+    return 0
+
+
+def cmd_acl_policy_list(args) -> int:
+    c = _client(args)
+    for p in c._request("GET", "/v1/acl/policies"):
+        print(p.get("Name", p.get("name", "")))
+    return 0
+
+
+def cmd_acl_policy_delete(args) -> int:
+    c = _client(args)
+    c._request("DELETE", f"/v1/acl/policy/{args.name}")
+    print(f"==> deleted policy {args.name}")
+    return 0
+
+
+def cmd_acl_token_create(args) -> int:
+    c = _client(args)
+    out = c._request(
+        "POST",
+        "/v1/acl/token",
+        body={
+            "Name": args.name,
+            "Type": args.type,
+            "Policies": args.policy or [],
+        },
+    )
+    print(f"Accessor ID = {out['AccessorID']}")
+    print(f"Secret ID   = {out['SecretID']}")
+    return 0
+
+
+def cmd_acl_token_list(args) -> int:
+    c = _client(args)
+    for t in c._request("GET", "/v1/acl/tokens"):
+        print(
+            f"{t.get('AccessorID', '')[:8]}  {t.get('Type', ''):<10} "
+            f"{t.get('Name', '')}"
+        )
+    return 0
+
+
+def cmd_acl_token_delete(args) -> int:
+    c = _client(args)
+    c._request("DELETE", f"/v1/acl/token/{args.accessor}")
+    print(f"==> deleted token {args.accessor}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    import nomad_tpu
+
+    print(f"nomad-tpu v{nomad_tpu.__version__}")
+    return 0
+
+
 def cmd_operator_raft_list(args) -> int:
     """`nomad operator raft list-peers`
     (command/operator_raft_list.go)."""
@@ -611,6 +795,11 @@ def cmd_server_members(args) -> int:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu")
     p.add_argument("-address", "--address", default=DEFAULT_ADDR)
+    p.add_argument(
+        "-token", "--token",
+        default=os.environ.get("NOMAD_TOKEN", ""),
+        help="ACL secret (or env NOMAD_TOKEN)",
+    )
     sub = p.add_subparsers(dest="cmd", required=True)
 
     agent = sub.add_parser("agent", help="run an agent")
@@ -645,6 +834,27 @@ def build_parser() -> argparse.ArgumentParser:
     stop = job.add_parser("stop")
     stop.add_argument("job_id")
     stop.set_defaults(fn=cmd_job_stop)
+    hist = job.add_parser("history")
+    hist.add_argument("job_id")
+    hist.set_defaults(fn=cmd_job_history)
+    insp = job.add_parser("inspect")
+    insp.add_argument("job_id")
+    insp.set_defaults(fn=cmd_job_inspect)
+    rev = job.add_parser("revert")
+    rev.add_argument("job_id")
+    rev.add_argument("version")
+    rev.set_defaults(fn=cmd_job_revert)
+    jeval = job.add_parser("eval")
+    jeval.add_argument("job_id")
+    jeval.set_defaults(fn=cmd_job_eval)
+    disp = job.add_parser("dispatch")
+    disp.add_argument("job_id")
+    disp.add_argument("--payload", default="")
+    disp.add_argument("--meta", action="append", metavar="key=value")
+    disp.set_defaults(fn=cmd_job_dispatch)
+    pforce = job.add_parser("periodic-force")
+    pforce.add_argument("job_id")
+    pforce.set_defaults(fn=cmd_job_periodic_force)
 
     node = sub.add_parser("node", help="node commands").add_subparsers(
         dest="sub", required=True
@@ -685,6 +895,8 @@ def build_parser() -> argparse.ArgumentParser:
     estatus = ev.add_parser("status")
     estatus.add_argument("eval_id")
     estatus.set_defaults(fn=cmd_eval_status)
+    elist = ev.add_parser("list")
+    elist.set_defaults(fn=cmd_eval_list)
 
     dep = sub.add_parser("deployment", help="deployment commands").add_subparsers(
         dest="sub", required=True
@@ -738,6 +950,60 @@ def build_parser() -> argparse.ArgumentParser:
     rrem = raft.add_parser("remove-peer")
     rrem.add_argument("--peer-id", dest="peer_id", required=True)
     rrem.set_defaults(fn=cmd_operator_raft_remove)
+    osnap = op.add_parser("snapshot", help="snapshot commands").add_subparsers(
+        dest="snap_cmd", required=True
+    )
+    osave = osnap.add_parser("save")
+    osave.add_argument("path")
+    osave.set_defaults(fn=cmd_operator_snapshot_save)
+    omet = op.add_parser("metrics")
+    omet.set_defaults(fn=cmd_operator_metrics)
+
+    system = sub.add_parser("system", help="system commands").add_subparsers(
+        dest="sub", required=True
+    )
+    sgc = system.add_parser("gc")
+    sgc.set_defaults(fn=cmd_system_gc)
+
+    scaling = sub.add_parser("scaling", help="scaling commands").add_subparsers(
+        dest="sub", required=True
+    )
+    spol = scaling.add_parser("policies")
+    spol.set_defaults(fn=cmd_scaling_policies)
+
+    acl = sub.add_parser("acl", help="acl commands").add_subparsers(
+        dest="acl_cmd", required=True
+    )
+    aboot = acl.add_parser("bootstrap")
+    aboot.set_defaults(fn=cmd_acl_bootstrap)
+    apol = acl.add_parser("policy").add_subparsers(
+        dest="pol_cmd", required=True
+    )
+    apapply = apol.add_parser("apply")
+    apapply.add_argument("name")
+    apapply.add_argument("rules_file")
+    apapply.set_defaults(fn=cmd_acl_policy_apply)
+    aplist = apol.add_parser("list")
+    aplist.set_defaults(fn=cmd_acl_policy_list)
+    apdel = apol.add_parser("delete")
+    apdel.add_argument("name")
+    apdel.set_defaults(fn=cmd_acl_policy_delete)
+    atok = acl.add_parser("token").add_subparsers(
+        dest="tok_cmd", required=True
+    )
+    atcreate = atok.add_parser("create")
+    atcreate.add_argument("--name", default="")
+    atcreate.add_argument("--type", default="client")
+    atcreate.add_argument("--policy", action="append")
+    atcreate.set_defaults(fn=cmd_acl_token_create)
+    atlist = atok.add_parser("list")
+    atlist.set_defaults(fn=cmd_acl_token_list)
+    atdel = atok.add_parser("delete")
+    atdel.add_argument("accessor")
+    atdel.set_defaults(fn=cmd_acl_token_delete)
+
+    ver = sub.add_parser("version", help="show version")
+    ver.set_defaults(fn=cmd_version)
 
     nsp = sub.add_parser("namespace", help="namespace commands").add_subparsers(
         dest="ns_cmd", required=True

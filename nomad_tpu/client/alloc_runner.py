@@ -12,6 +12,7 @@ any task failed ⇒ failed; any running ⇒ running; all dead+ok ⇒ complete.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import threading
@@ -26,6 +27,8 @@ from ..structs import (
 )
 from .task_runner import TaskRunner, TaskState
 
+log = logging.getLogger("nomad_tpu.alloc_runner")
+
 
 class AllocRunner:
     def __init__(
@@ -37,6 +40,8 @@ class AllocRunner:
         restored_handles: Optional[dict] = None,
         on_handle: Optional[Callable] = None,
         prev_watcher: Optional[Callable] = None,
+        device_plugins: Optional[dict] = None,
+        device_group_owner: Optional[dict] = None,
     ):
         self.alloc = alloc
         self.drivers = drivers
@@ -49,6 +54,10 @@ class AllocRunner:
         # task_name → recovered TaskHandle (client restart re-attach)
         self.restored_handles = restored_handles or {}
         self.on_handle = on_handle
+        # device-plugin clients (name → DevicePluginClient) for Reserve,
+        # plus the (vendor, type, name) → plugin-name ownership map
+        self.device_plugins = device_plugins or {}
+        self.device_group_owner = device_group_owner or {}
         self.task_runners: dict[str, TaskRunner] = {}
         self.task_states: dict[str, TaskState] = {}
         self._lock = threading.Lock()
@@ -72,6 +81,12 @@ class AllocRunner:
             "NOMAD_GROUP_NAME": tg.name,
         }
         os.makedirs(env["NOMAD_ALLOC_DIR"], exist_ok=True)
+        try:
+            env.update(self._reserve_devices())
+        except RuntimeError as e:
+            log.warning("alloc %s: %s", self.alloc.id[:8], e)
+            self._report(ALLOC_CLIENT_FAILED, str(e))
+            return
         for task in tg.tasks:
             driver = self.drivers.get(task.driver)
             if driver is None:
@@ -96,6 +111,42 @@ class AllocRunner:
         for tr in self.task_runners.values():
             tr.start()
         self._report(ALLOC_CLIENT_RUNNING, "tasks are running")
+
+    def _reserve_devices(self) -> dict:
+        """Resolve the alloc's scheduled device instances through the
+        device plugins (device.proto Reserve): each AllocatedDeviceResource
+        routes to the plugin that OWNS its (vendor, type, name) group, and
+        the reservation's env mutations flow into every task's environment
+        (the reference mutates the container config; env is this build's
+        common denominator across drivers). A failed reservation FAILS the
+        alloc — starting without device isolation would let the task use
+        instances reserved by other allocs."""
+        assigned = getattr(self.alloc, "allocated_devices", None) or []
+        if not assigned or not self.device_plugins:
+            return {}
+        envs: dict = {}
+        for ad in assigned:
+            ids = list(getattr(ad, "device_ids", None) or [])
+            if not ids:
+                continue
+            owner = self.device_group_owner.get(
+                (ad.vendor, ad.type, ad.name)
+            )
+            dp = self.device_plugins.get(owner) if owner else None
+            if dp is None:
+                raise RuntimeError(
+                    f"no device plugin owns group "
+                    f"{ad.vendor}/{ad.type}/{ad.name}"
+                )
+            try:
+                res = dp.reserve(ids)
+            except Exception as e:
+                raise RuntimeError(
+                    f"device reserve failed for "
+                    f"{ad.vendor}/{ad.type}/{ad.name}: {e}"
+                ) from e
+            envs.update(res.get("envs") or {})
+        return envs
 
     def _migrate_previous(self, tg) -> None:
         """Previous-alloc data migration (client/allocwatcher +

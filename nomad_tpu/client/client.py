@@ -61,6 +61,7 @@ class Client:
         host_volumes: Optional[dict] = None,
         serve_endpoints: bool = True,
         driver_mode: str = "inprocess",
+        device_plugins: Optional[list[str]] = None,
     ):
         self.rpc = rpc
         self.data_dir = data_dir
@@ -77,6 +78,36 @@ class Client:
         else:
             self.drivers = builtin_drivers()
         self.node = fingerprint_node(node, data_dir=data_dir, drivers=self.drivers)
+        # out-of-process device plugins (device.proto analog — see
+        # client/device_plugin.py): fingerprinted groups surface on the
+        # node for the scheduler's DeviceChecker/allocator; reservations
+        # are resolved at task start into env/mount mutations
+        self.device_plugins: dict[str, object] = {}
+        # (vendor, type, name) → plugin name: Reserve must route each
+        # allocated device group to the plugin that OWNS it (sending ids
+        # to every plugin would let e.g. the jax plugin misparse a fake
+        # device id into a TPU ordinal pin)
+        self.device_group_owner: dict[tuple, str] = {}
+        for dp_name in device_plugins or []:
+            from .device_plugin import DevicePluginClient
+
+            dp = DevicePluginClient(dp_name)
+            try:
+                groups = dp.fingerprint()
+            except Exception:
+                log.warning("device plugin %s failed", dp_name, exc_info=True)
+                continue
+            self.device_plugins[dp_name] = dp
+            if groups:
+                self.node.node_resources.devices.extend(groups)
+                for g in groups:
+                    self.device_group_owner[
+                        (g.vendor, g.type, g.name)
+                    ] = dp_name
+                self.node.attributes[f"device.{dp_name}"] = str(
+                    sum(len(g.instances) for g in groups)
+                )
+                self.node.compute_class()
         if host_volumes:
             # client config host_volume blocks surface on the node for the
             # HostVolumeChecker (structs.ClientHostVolumeConfig)
@@ -151,6 +182,11 @@ class Client:
             close = getattr(d, "close", None)
             if close is not None:
                 close()
+        for dp in self.device_plugins.values():
+            try:
+                dp.close()
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                pass
         self.state_db.close()
 
     # -- restore (client/state StateDB; task_runner.go:488-519) -----------
@@ -173,6 +209,8 @@ class Client:
                 on_update=self._on_alloc_update,
                 restored_handles=recovered,
                 on_handle=self.state_db.put_handle,
+                device_plugins=self.device_plugins,
+                device_group_owner=self.device_group_owner,
             )
             with self._lock:
                 self.runners[alloc.id] = runner
@@ -368,6 +406,8 @@ class Client:
                 on_update=self._on_alloc_update,
                 on_handle=self.state_db.put_handle,
                 prev_watcher=self._watch_previous_alloc,
+                device_plugins=self.device_plugins,
+                device_group_owner=self.device_group_owner,
             )
             with self._lock:
                 self.runners[alloc_id] = runner

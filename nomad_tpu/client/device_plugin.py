@@ -1,0 +1,336 @@
+"""Out-of-process DEVICE plugin contract — the device.proto analog.
+
+Reference: plugins/device/proto/device.proto + plugins/device/device.go:
+a device plugin is a separate process the client talks to over a typed
+contract with three calls — ``Fingerprint`` (stream of detected device
+groups), ``Reserve`` (instance ids → container/env mutations), and
+``Stats`` (per-instance usage). The reference speaks gRPC to a hashicorp
+go-plugin binary; this build reuses the framework's NDJSON stdio plugin
+transport (client/plugin.py's wire style), so device plugins get the
+same lifecycle/reattach properties as driver plugins without a protobuf
+toolchain.
+
+Wire protocol (one JSON object per line):
+  plugin → host  {"type": "handshake", "magic": ..., "version": 1,
+                  "plugin": "<name>"}
+  host → plugin  {"id": N, "method": "fingerprint" | "reserve" | "stats",
+                  "params": {...}}
+  plugin → host  {"id": N, "result": ...} | {"id": N, "error": "..."}
+
+A plugin is any executable speaking this protocol; the builtin launcher
+(``python -m nomad_tpu.client.device_plugin <name>``) serves the
+plugins registered in BUILTIN_DEVICE_PLUGINS (the jax/TPU plugin and a
+test fake), mirroring how driver plugins are spawned.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..structs.resources import NodeDeviceInstance, NodeDeviceResource
+
+DEVICE_PLUGIN_MAGIC = "NOMAD_TPU_DEVICE_V1"
+DEVICE_PROTO_VERSION = 1
+HANDSHAKE_TIMEOUT_S = 10.0
+
+
+class DevicePlugin:
+    """Base class for the plugin-side implementation."""
+
+    name = "device"
+
+    def fingerprint(self) -> list[dict]:
+        """Detected device groups: [{vendor, type, name, instances:
+        [{id, healthy}], attributes: {...}}]."""
+        return []
+
+    def reserve(self, device_ids: list[str]) -> dict:
+        """Reservation response (device.proto ContainerReservation):
+        {"envs": {...}, "mounts": [...], "devices": [...]}."""
+        return {"envs": {}, "mounts": [], "devices": []}
+
+    def stats(self) -> dict:
+        """Per-instance stats: {instance_id: {...}}."""
+        return {}
+
+
+class JaxDevicePlugin(DevicePlugin):
+    """The native accelerator plugin: surfaces the jax device table (the
+    TPU) as a schedulable device group — the drivers/gpu analog for this
+    framework's own hardware."""
+
+    name = "jax"
+
+    def fingerprint(self) -> list[dict]:
+        try:
+            import jax
+
+            accel = [
+                d for d in jax.devices() if d.platform not in ("cpu",)
+            ]
+        except Exception:  # noqa: BLE001 — no backend = no devices
+            return []
+        if not accel:
+            return []
+        platform = accel[0].platform
+        return [
+            {
+                "vendor": "google",
+                "type": "tpu" if platform == "tpu" else platform,
+                "name": getattr(
+                    accel[0], "device_kind", platform
+                ).replace(" ", "-").lower(),
+                "instances": [
+                    {"id": f"{platform}-{d.id}", "healthy": True}
+                    for d in accel
+                ],
+                "attributes": {"count": len(accel)},
+            }
+        ]
+
+    def reserve(self, device_ids: list[str]) -> dict:
+        ordinals = ",".join(
+            did.rsplit("-", 1)[-1] for did in device_ids
+        )
+        # the jax-visible-devices env the runtime consumes
+        return {
+            "envs": {"JAX_VISIBLE_DEVICES": ordinals},
+            "mounts": [],
+            "devices": [],
+        }
+
+
+class FakeDevicePlugin(DevicePlugin):
+    """Deterministic test plugin: devices configured via env."""
+
+    name = "fake"
+
+    def fingerprint(self) -> list[dict]:
+        spec = os.environ.get("NOMAD_FAKE_DEVICES", "")
+        if not spec:
+            return []
+        # "vendor/type/name:n"
+        head, _, n = spec.partition(":")
+        vendor, type_, name = head.split("/")
+        return [
+            {
+                "vendor": vendor,
+                "type": type_,
+                "name": name,
+                "instances": [
+                    {"id": f"{name}-{i}", "healthy": True}
+                    for i in range(int(n or 1))
+                ],
+                "attributes": {"memory_mb": 1024},
+            }
+        ]
+
+    def reserve(self, device_ids: list[str]) -> dict:
+        return {
+            "envs": {"FAKE_VISIBLE_DEVICES": ",".join(device_ids)},
+            "mounts": [],
+            "devices": [f"/dev/fake/{d}" for d in device_ids],
+        }
+
+    def stats(self) -> dict:
+        return {
+            d["id"]: {"utilization": 0.0}
+            for g in self.fingerprint()
+            for d in g["instances"]
+        }
+
+
+BUILTIN_DEVICE_PLUGINS = {
+    p.name: p for p in (JaxDevicePlugin(), FakeDevicePlugin())
+}
+
+
+# -- plugin (server) side ----------------------------------------------------
+
+
+def serve_device_plugin(plugin: DevicePlugin, stdin=None, stdout=None):
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    wlock = threading.Lock()
+
+    def send(obj: dict) -> None:
+        with wlock:
+            stdout.write(json.dumps(obj) + "\n")
+            stdout.flush()
+
+    send(
+        {
+            "type": "handshake",
+            "magic": DEVICE_PLUGIN_MAGIC,
+            "version": DEVICE_PROTO_VERSION,
+            "plugin": plugin.name,
+        }
+    )
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        try:
+            if method == "fingerprint":
+                result = plugin.fingerprint()
+            elif method == "reserve":
+                result = plugin.reserve(params.get("device_ids") or [])
+            elif method == "stats":
+                result = plugin.stats()
+            elif method == "shutdown":
+                send({"id": rid, "result": True})
+                return
+            else:
+                send({"id": rid, "error": f"unknown method {method!r}"})
+                continue
+            send({"id": rid, "result": result})
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            send({"id": rid, "error": str(e)})
+
+
+# -- host (client) side ------------------------------------------------------
+
+
+class DevicePluginClient:
+    """Spawns and drives one device plugin subprocess."""
+
+    def __init__(self, name: str, argv: Optional[list[str]] = None):
+        self.name = name
+        self._argv = argv or [
+            sys.executable, "-m", "nomad_tpu.client.device_plugin", name,
+        ]
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def _ensure(self) -> None:
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                return
+            self._proc = subprocess.Popen(
+                self._argv,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+                env={**os.environ, "PYTHONPATH": os.pathsep.join(sys.path)},
+            )
+            # bounded handshake (same hazard as driver plugins: a hung
+            # plugin must not wedge the fingerprint pass)
+            deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
+            fd = self._proc.stdout.fileno()
+            buf = b""
+            while b"\n" not in buf:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._proc.kill()
+                    self._proc.wait()
+                    raise RuntimeError(
+                        f"device plugin {self.name!r} handshake timeout"
+                    )
+                ready, _, _ = select.select([fd], [], [], remaining)
+                if not ready:
+                    continue
+                chunk = os.read(fd, 4096)
+                if not chunk:
+                    break
+                buf += chunk
+            hs = json.loads(buf.partition(b"\n")[0] or b"{}")
+            if (
+                hs.get("magic") != DEVICE_PLUGIN_MAGIC
+                or hs.get("version") != DEVICE_PROTO_VERSION
+            ):
+                self._proc.kill()
+                raise RuntimeError(
+                    f"device plugin handshake failed: {hs!r}"
+                )
+
+    def _call(self, method: str, params: Optional[dict] = None):
+        self._ensure()
+        with self._lock:
+            self._next_id += 1
+            rid = self._next_id
+            self._proc.stdin.write(
+                json.dumps(
+                    {"id": rid, "method": method, "params": params or {}}
+                )
+                + "\n"
+            )
+            self._proc.stdin.flush()
+            line = self._proc.stdout.readline()
+        if not line:
+            raise RuntimeError(f"device plugin {self.name!r} exited")
+        msg = json.loads(line)
+        if msg.get("error"):
+            raise RuntimeError(msg["error"])
+        return msg.get("result")
+
+    # -- contract ----------------------------------------------------------
+    def fingerprint(self) -> list[NodeDeviceResource]:
+        groups = self._call("fingerprint") or []
+        out = []
+        for g in groups:
+            out.append(
+                NodeDeviceResource(
+                    vendor=g.get("vendor", ""),
+                    type=g.get("type", ""),
+                    name=g.get("name", ""),
+                    instances=[
+                        NodeDeviceInstance(
+                            id=i.get("id", ""),
+                            healthy=bool(i.get("healthy", True)),
+                        )
+                        for i in g.get("instances", [])
+                    ],
+                    attributes=dict(g.get("attributes") or {}),
+                )
+            )
+        return out
+
+    def reserve(self, device_ids: list[str]) -> dict:
+        return self._call("reserve", {"device_ids": device_ids}) or {}
+
+    def stats(self) -> dict:
+        return self._call("stats") or {}
+
+    def close(self) -> None:
+        p = self._proc
+        if p is None:
+            return
+        try:
+            self._call("shutdown")
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            p.terminate()
+            p.wait(timeout=2)
+        except Exception:  # noqa: BLE001
+            p.kill()
+        self._proc = None
+
+
+def _main() -> None:
+    name = sys.argv[1] if len(sys.argv) > 1 else "fake"
+    plugin = BUILTIN_DEVICE_PLUGINS.get(name)
+    if plugin is None:
+        print(f"unknown device plugin {name!r}", file=sys.stderr)
+        raise SystemExit(2)
+    serve_device_plugin(plugin)
+
+
+if __name__ == "__main__":
+    _main()

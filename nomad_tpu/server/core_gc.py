@@ -45,6 +45,8 @@ class CoreScheduler:
         # tracked by wall-clock observation of terminal records
         self._first_seen_terminal: dict[str, float] = {}
         self._seen_this_pass: set[str] = set()
+        self._force_pass = False
+        self._pass_lock = threading.Lock()
 
     def start(self) -> None:
         self._stop.clear()
@@ -69,27 +71,44 @@ class CoreScheduler:
 
     def _aged(self, key: str, threshold: float, now: float) -> bool:
         self._seen_this_pass.add(key)
+        if self._force_pass:
+            # operator-forced sweep (`nomad system gc`): thresholds are
+            # waived, and first-seen stamps must NOT be fabricated with
+            # the forced clock — a fake future stamp would exempt the
+            # object from every later periodic pass
+            self._first_seen_terminal.setdefault(key, now)
+            return True
         first = self._first_seen_terminal.setdefault(key, now)
         return now - first >= threshold
 
     # -- passes ------------------------------------------------------------
-    def gc_all(self, now: Optional[float] = None) -> dict[str, int]:
-        now = now or time.time()
-        self._seen_this_pass = set()
-        stats = {
-            "evals": self.gc_evals(now),
-            "jobs": self.gc_jobs(now),
-            "nodes": self.gc_nodes(now),
-            "deployments": self.gc_deployments(now),
-        }
-        # prune bookkeeping for records that are gone (reaped or deleted) —
-        # the observation clock must not grow with lifetime object count
-        self._first_seen_terminal = {
-            k: v
-            for k, v in self._first_seen_terminal.items()
-            if k in self._seen_this_pass
-        }
-        return stats
+    def gc_all(
+        self, now: Optional[float] = None, force: bool = False
+    ) -> dict[str, int]:
+        # one pass at a time: the periodic thread and an operator-forced
+        # sweep share the _seen/_first_seen bookkeeping
+        with self._pass_lock:
+            now = now or time.time()
+            self._seen_this_pass = set()
+            self._force_pass = force
+            try:
+                stats = {
+                    "evals": self.gc_evals(now),
+                    "jobs": self.gc_jobs(now),
+                    "nodes": self.gc_nodes(now),
+                    "deployments": self.gc_deployments(now),
+                }
+            finally:
+                self._force_pass = False
+            # prune bookkeeping for records that are gone (reaped or
+            # deleted) — the observation clock must not grow with
+            # lifetime object count
+            self._first_seen_terminal = {
+                k: v
+                for k, v in self._first_seen_terminal.items()
+                if k in self._seen_this_pass
+            }
+            return stats
 
     def gc_evals(self, now: float) -> int:
         """Terminal evals + their terminal allocs (core_sched.go evalGC)."""

@@ -53,6 +53,7 @@ class ServerConfig:
         deployment_watch_interval: float = 0.25,
         acl_enabled: bool = False,
         data_dir: Optional[str] = None,
+        num_batch_workers: int = 1,
     ):
         self.num_workers = num_workers
         self.region = region
@@ -60,13 +61,20 @@ class ServerConfig:
         self.deployment_watch_interval = deployment_watch_interval
         self.acl_enabled = acl_enabled
         self.data_dir = data_dir
+        # workers 0..n-1 run batched device passes, each on its own
+        # job-hash partition of the eval stream (the rest drain solo
+        # evals). >1 needs the broker's partitioned queues so two
+        # batched passes never carry the same jobs.
+        self.num_batch_workers = max(1, min(num_batch_workers, num_workers or 1))
 
 
 class Server:
     def __init__(self, config: Optional[ServerConfig] = None):
         self.config = config or ServerConfig()
         self.store = StateStore()
-        self.eval_broker = EvalBroker()
+        self.eval_broker = EvalBroker(
+            n_partitions=self.config.num_batch_workers
+        )
         self.blocked_evals = BlockedEvals(broker=self.eval_broker)
         self.plan_queue = PlanQueue()
         self.plan_apply_loop = PlanApplyLoop(

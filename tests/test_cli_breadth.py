@@ -1,0 +1,160 @@
+"""CLI breadth smoke (command/ families: job history/inspect/revert/eval/
+dispatch, eval list, system gc, operator snapshot/metrics, scaling, acl,
+version) + the HTTP endpoints backing them (job versions/revert/evaluate,
+system gc)."""
+
+import json
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.api.client import NomadClient
+from nomad_tpu.api.http import HTTPAgent
+from nomad_tpu.api.codec import encode
+from nomad_tpu.cli.main import main
+
+
+def wait_until(cond, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(scope="module")
+def harness(tmp_path_factory):
+    agent = DevAgent(
+        data_dir=str(tmp_path_factory.mktemp("agent")), num_workers=1
+    )
+    agent.start()
+    http = HTTPAgent(agent.server, agent.client, port=0)
+    http.start()
+    client = NomadClient(http.address)
+    yield agent, client
+    http.stop()
+    agent.shutdown()
+
+
+def service_payload(job_id="cli-svc", run_for=600):
+    j = mock.job()
+    j.id = job_id
+    j.task_groups[0].count = 1
+    j.task_groups[0].tasks[0].driver = "mock_driver"
+    j.task_groups[0].tasks[0].config = {"run_for": run_for}
+    j.task_groups[0].tasks[0].resources.cpu = 50
+    j.task_groups[0].tasks[0].resources.memory_mb = 32
+    return encode(j)
+
+
+class TestJobLifecycleCLI:
+    def test_history_inspect_revert_eval(self, harness, capsys):
+        agent, c = harness
+        addr = ["--address", c.address]
+        c.jobs.register(service_payload(run_for=600))
+        c.jobs.register(service_payload(run_for=601))  # version 1
+
+        assert main(addr + ["job", "history", "cli-svc"]) == 0
+        out = capsys.readouterr().out
+        assert "Version" in out and "1" in out
+
+        assert main(addr + ["job", "inspect", "cli-svc"]) == 0
+        out = capsys.readouterr().out
+        assert '"cli-svc"' in out
+
+        # revert to version 0 → becomes version 2
+        assert main(addr + ["job", "revert", "cli-svc", "0"]) == 0
+        cur = agent.store.job_by_id("default", "cli-svc")
+        assert cur.version == 2
+        assert cur.task_groups[0].tasks[0].config["run_for"] == 600
+
+        assert main(addr + ["job", "eval", "cli-svc"]) == 0
+        out = capsys.readouterr().out
+        assert "created evaluation" in out
+
+        assert main(addr + ["eval", "list"]) == 0
+        out = capsys.readouterr().out
+        assert "cli-svc" in out
+
+    def test_dispatch_parameterized(self, harness, capsys):
+        agent, c = harness
+        j = mock.job()
+        j.id = "cli-param"
+        j.task_groups[0].count = 1
+        j.task_groups[0].tasks[0].driver = "mock_driver"
+        j.task_groups[0].tasks[0].config = {"run_for": 0.05}
+        from nomad_tpu.structs.job import ParameterizedJobConfig
+
+        j.parameterized = ParameterizedJobConfig(payload="optional")
+        c.jobs.register(encode(j))
+        addr = ["--address", c.address]
+        assert main(addr + ["job", "dispatch", "cli-param"]) == 0
+        out = capsys.readouterr().out
+        assert "dispatched" in out
+
+
+class TestOperatorCLI:
+    def test_system_gc(self, harness, capsys):
+        agent, c = harness
+        addr = ["--address", c.address]
+        assert main(addr + ["system", "gc"]) == 0
+        assert "gc:" in capsys.readouterr().out
+
+    def test_snapshot_save(self, harness, tmp_path_factory, capsys):
+        agent, c = harness
+        path = str(tmp_path_factory.mktemp("snap") / "state.snap")
+        addr = ["--address", c.address]
+        assert main(addr + ["operator", "snapshot", "save", path]) == 0
+        import os
+
+        assert os.path.exists(path)
+
+    def test_metrics_and_scaling_and_version(self, harness, capsys):
+        agent, c = harness
+        addr = ["--address", c.address]
+        assert main(addr + ["operator", "metrics"]) == 0
+        assert main(addr + ["scaling", "policies"]) == 0
+        assert main(addr + ["version"]) == 0
+        assert "nomad-tpu v" in capsys.readouterr().out
+
+
+class TestACLCLI:
+    def test_acl_family_through_cli(self, tmp_path, capsys):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        s = Server(ServerConfig(num_workers=0, acl_enabled=True))
+        http = HTTPAgent(s, port=0)
+        http.start()
+        try:
+            boot = s.acl.bootstrap()
+            addr = [
+                "--address", http.address, "--token", boot.secret_id
+            ]
+            rules = tmp_path / "ro.hcl"
+            rules.write_text('namespace "default" { policy = "read" }')
+            assert main(
+                addr + ["acl", "policy", "apply", "readonly", str(rules)]
+            ) == 0
+            assert main(addr + ["acl", "policy", "list"]) == 0
+            assert "readonly" in capsys.readouterr().out
+            assert main(
+                addr
+                + [
+                    "acl", "token", "create",
+                    "--name", "ro", "--policy", "readonly",
+                ]
+            ) == 0
+            out = capsys.readouterr().out
+            assert "Secret ID" in out
+            assert main(addr + ["acl", "token", "list"]) == 0
+            out = capsys.readouterr().out
+            assert "ro" in out
+            assert main(
+                addr + ["acl", "policy", "delete", "readonly"]
+            ) == 0
+        finally:
+            http.stop()
+            s.shutdown()

@@ -1,0 +1,153 @@
+"""Out-of-process device plugin contract (client/device_plugin.py — the
+device.proto analog): handshake + fingerprint/reserve/stats over the
+stdio NDJSON transport, node surface integration, and reservation env
+flowing into task environments."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu.client.device_plugin import (
+    DevicePluginClient,
+    FakeDevicePlugin,
+)
+
+
+@pytest.fixture()
+def fake_devices():
+    os.environ["NOMAD_FAKE_DEVICES"] = "acme/gpu/model-x:3"
+    yield
+    os.environ.pop("NOMAD_FAKE_DEVICES", None)
+
+
+class TestDevicePluginProtocol:
+    def test_fingerprint_over_subprocess(self, fake_devices):
+        dp = DevicePluginClient("fake")
+        try:
+            groups = dp.fingerprint()
+            assert len(groups) == 1
+            g = groups[0]
+            assert (g.vendor, g.type, g.name) == ("acme", "gpu", "model-x")
+            assert [i.id for i in g.instances] == [
+                "model-x-0", "model-x-1", "model-x-2",
+            ]
+            assert g.attributes["memory_mb"] == 1024
+        finally:
+            dp.close()
+
+    def test_reserve_and_stats(self, fake_devices):
+        dp = DevicePluginClient("fake")
+        try:
+            res = dp.reserve(["model-x-0", "model-x-2"])
+            assert res["envs"]["FAKE_VISIBLE_DEVICES"] == (
+                "model-x-0,model-x-2"
+            )
+            assert "/dev/fake/model-x-0" in res["devices"]
+            stats = dp.stats()
+            assert "model-x-0" in stats
+        finally:
+            dp.close()
+
+    def test_plugin_respawns_after_death(self, fake_devices):
+        dp = DevicePluginClient("fake")
+        try:
+            assert dp.fingerprint()
+            dp._proc.kill()
+            dp._proc.wait()
+            # next call respawns transparently
+            assert dp.fingerprint()
+        finally:
+            dp.close()
+
+    def test_unknown_plugin_rejected(self):
+        dp = DevicePluginClient("nonexistent")
+        with pytest.raises(RuntimeError):
+            dp.fingerprint()
+
+
+class TestClientIntegration:
+    def test_devices_surface_on_node_and_env_reaches_task(
+        self, fake_devices, tmp_path
+    ):
+        """A client with the fake device plugin: the node advertises the
+        group (scheduler-visible), and an alloc with assigned instances
+        gets the reservation env in its tasks."""
+        from nomad_tpu import mock
+        from nomad_tpu.agent import DevAgent
+
+        agent = DevAgent(
+            data_dir=str(tmp_path), num_workers=1,
+            device_plugins=["fake"],
+        )
+        agent.start()
+        try:
+            node = agent.client.node
+            assert any(
+                d.name == "model-x" for d in node.node_resources.devices
+            )
+            assert node.attributes.get("device.fake") == "3"
+
+            # a job asking for the device: scheduler assigns instances,
+            # and the reservation env lands in the task environment
+            from nomad_tpu.structs.resources import RequestedDevice
+
+            job = mock.job()
+            job.id = "dev-job"
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.tasks[0].driver = "raw_exec"
+            tg.tasks[0].config = {
+                "command": "/bin/sh",
+                "args": ["-c", "echo dev=$FAKE_VISIBLE_DEVICES"],
+            }
+            tg.tasks[0].resources.cpu = 50
+            tg.tasks[0].resources.memory_mb = 32
+            tg.tasks[0].resources.devices = [
+                RequestedDevice(name="gpu", count=2)
+            ]
+            agent.register_job(job)
+
+            def done():
+                allocs = [
+                    a
+                    for a in agent.store.allocs_by_job(
+                        job.namespace, job.id
+                    )
+                    if a.allocated_devices
+                ]
+                if not allocs:
+                    return False
+                runner = agent.client.runners.get(allocs[0].id)
+                if runner is None:
+                    return False
+                out = os.path.join(
+                    runner.alloc_dir, tg.tasks[0].name,
+                    f"{tg.tasks[0].name}.stdout",
+                )
+                if not os.path.exists(out):
+                    return False
+                return "dev=" in open(out).read()
+
+            deadline = time.time() + 30
+            while time.time() < deadline and not done():
+                time.sleep(0.1)
+            assert done(), "device env did not reach the task"
+            alloc = next(
+                a
+                for a in agent.store.allocs_by_job(job.namespace, job.id)
+                if a.allocated_devices
+            )
+            ids = alloc.allocated_devices[0].device_ids
+            assert len(ids) == 2
+            runner = agent.client.runners[alloc.id]
+            out = open(
+                os.path.join(
+                    runner.alloc_dir, tg.tasks[0].name,
+                    f"{tg.tasks[0].name}.stdout",
+                )
+            ).read()
+            for did in ids:
+                assert did in out
+        finally:
+            agent.shutdown()
