@@ -122,8 +122,16 @@ class AllocRunner:
         alloc — starting without device isolation would let the task use
         instances reserved by other allocs."""
         assigned = getattr(self.alloc, "allocated_devices", None) or []
-        if not assigned or not self.device_plugins:
+        if not assigned:
             return {}
+        if not self.device_plugins:
+            # scheduled device instances with no plugin to reserve them
+            # (e.g. the plugin failed fingerprint on restart): starting
+            # unconfined would let the task use other allocs' instances
+            raise RuntimeError(
+                "alloc has allocated devices but no device plugin is "
+                "available to reserve them"
+            )
         envs: dict = {}
         for ad in assigned:
             ids = list(getattr(ad, "device_ids", None) or [])

@@ -99,9 +99,15 @@ class JaxDevicePlugin(DevicePlugin):
         ordinals = ",".join(
             did.rsplit("-", 1)[-1] for did in device_ids
         )
-        # the jax-visible-devices env the runtime consumes
+        # the visibility knobs the runtimes actually honor: the TPU
+        # runtime reads TPU_VISIBLE_CHIPS (newer) / TPU_VISIBLE_DEVICES
+        # (older); CUDA backends read CUDA_VISIBLE_DEVICES
         return {
-            "envs": {"JAX_VISIBLE_DEVICES": ordinals},
+            "envs": {
+                "TPU_VISIBLE_CHIPS": ordinals,
+                "TPU_VISIBLE_DEVICES": ordinals,
+                "CUDA_VISIBLE_DEVICES": ordinals,
+            },
             "mounts": [],
             "devices": [],
         }

@@ -71,12 +71,20 @@ class DeviceStateCache:
     def _refresh_locked(self, snap) -> ClusterTensors:
         ct = self._ct
         if ct is not None and snap.index < ct.index:
-            # a worker holding an older snapshot than the resident
-            # generation: serve it a transient build WITHOUT regressing
-            # the shared generation (other workers would have to patch
-            # forward again — flatten ping-pong)
+            # A worker holding an older snapshot than the resident
+            # generation: serve the RESIDENT build. Its usage is newer
+            # than the snapshot — strictly MORE accurate for optimistic
+            # placement (it already includes commits the snapshot
+            # missed); the plan applier re-checks against live state
+            # either way. The alternative (a transient rebuild from the
+            # old snapshot) is quadratically worse under pipelined
+            # workers: it is a full reflatten per pass, its row order
+            # differs from the resident layout (layout_gen 0) so the
+            # shared optimistic overlay gets dropped, and its usage
+            # EXCLUDES the other workers' in-flight commits — measured
+            # as >90% applier rejection of whole passes.
             self.stale_builds += 1
-            return flatten_cluster(snap)
+            return ct
         if ct is None:
             return self._rebuild_locked(snap)
         if snap.index == ct.index:

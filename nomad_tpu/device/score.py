@@ -1016,6 +1016,7 @@ class PlacementKernel:
         overflow: int = OVERFLOW_CANDIDATES,
         decorrelate: bool = False,
         decorrelate_salt: int = 0,
+        decorrelate_workers: int = 1,  # concurrent batching workers
         used_override=None,  # [pn, D] optimistic usage (pipelined passes)
     ) -> list[PlacementResult]:
         """``overflow`` = extra greedy candidates emitted per lane for
@@ -1038,7 +1039,8 @@ class PlacementKernel:
         jitter = None
         if decorrelate:
             work = _decorrelate_lanes(
-                cluster, asks, salt=decorrelate_salt, used0=used0
+                cluster, asks, salt=decorrelate_salt, used0=used0,
+                n_workers=decorrelate_workers,
             )
             rows = np.arange(cluster.padded_n, dtype=np.int64)
             h = (rows * 2654435761 + (decorrelate_salt + 1) * 40503) & 0xFFFFFFFF
@@ -1352,7 +1354,9 @@ class PlacementKernel:
         return out
 
 
-def _decorrelate_lanes(cluster, asks: list, salt: int = 0, used0=None) -> list:
+def _decorrelate_lanes(
+    cluster, asks: list, salt: int = 0, used0=None, n_workers: int = 1
+) -> list:
     """Stripe each batch lane onto a disjoint subset of node rows
     (row % n_lanes == lane). Concurrent lanes scoring the same snapshot
     otherwise compute near-identical greedy sequences and pile onto the
@@ -1387,6 +1391,21 @@ def _decorrelate_lanes(cluster, asks: list, salt: int = 0, used0=None) -> list:
     row_hash = (rows.astype(np.uint64) * np.uint64(2654435761)) & np.uint64(
         0xFFFFFFFF
     )
+    # CONCURRENT batching workers must not share stripes at all: the salt
+    # only rotates lane→stripe assignment within the same congruence
+    # classes, so two workers' passes land one lane from each on every
+    # stripe and argmax the same best nodes (measured 0.83+ conflict at
+    # 2×32 deep). Partition the node universe by worker FIRST (a second,
+    # independent hash so it doesn't alias the lane stripes), then stripe
+    # within each worker's slice.
+    worker_universe = None
+    if n_workers > 1:
+        h2 = (rows.astype(np.uint64) * np.uint64(0x9E3779B1)) & np.uint64(
+            0xFFFFFFFF
+        )
+        worker_universe = (h2 % np.uint64(n_workers)).astype(np.int64) == (
+            salt % n_workers
+        )
     free = np.asarray(cluster.capacity) - (
         np.asarray(cluster.used) if used0 is None else np.asarray(used0)
     )  # [pn, D]
@@ -1412,8 +1431,58 @@ def _decorrelate_lanes(cluster, asks: list, salt: int = 0, used0=None) -> list:
         else:
             jn = np.full(pn, float(a.count))
         jn = np.where(a.eligible, jn, 0.0)
-        total_elig = int(a.eligible.sum())
-        slots = float(jn.sum())
+
+        # full-set value vocabulary per block, computed ONCE per ask —
+        # the reachability closure runs up to twice per lane in the hot
+        # decorrelation path
+        full_vals_per_block = (
+            [
+                np.unique(
+                    a.blocks.value_ids[b][
+                        (a.blocks.value_ids[b] >= 0) & a.eligible
+                    ]
+                ).shape[0]
+                for b in range(a.blocks.num_blocks)
+            ]
+            if a.blocks is not None
+            else []
+        )
+
+        def values_reachable(mask) -> bool:
+            # a node subset must not silently amputate spread/cap values:
+            # every value reachable from the full eligible set must stay
+            # reachable from the subset (rack-contiguous row orderings
+            # with racks smaller than the lane count would otherwise skew
+            # the spread with no error surfaced)
+            if a.blocks is None:
+                return True
+            for b in range(a.blocks.num_blocks):
+                vids = a.blocks.value_ids[b]
+                sub_vals = np.unique(vids[(vids >= 0) & mask])
+                if full_vals_per_block[b] != sub_vals.shape[0]:
+                    return False
+            return True
+
+        # this worker's node slice first (cross-worker disjointness),
+        # provided it still holds the lane's ask comfortably — else fall
+        # back to the full set and let repair/applier arbitrate
+        from ..utils.metrics import global_metrics as _metrics
+
+        base_elig = a.eligible
+        if worker_universe is not None:
+            wu_elig = a.eligible & worker_universe
+            if (
+                float(jn[wu_elig].sum()) >= 2 * a.count
+                and int(wu_elig.sum()) >= 8
+                and values_reachable(wu_elig)
+            ):
+                base_elig = wu_elig
+                _metrics.incr("nomad.kernel.lane_universe_applied")
+            else:
+                _metrics.incr("nomad.kernel.lane_universe_skipped")
+        jn_w = np.where(base_elig, jn, 0.0)
+        total_elig = int(base_elig.sum())
+        slots = float(jn_w.sum())
         l_eff = min(
             n_lanes,
             max(1, min(
@@ -1421,31 +1490,34 @@ def _decorrelate_lanes(cluster, asks: list, salt: int = 0, used0=None) -> list:
             )),
         )
         if l_eff < 2:
-            out.append(a)
+            out.append(
+                replace(a, eligible=base_elig)
+                if base_elig is not a.eligible
+                else a
+            )
             continue
         in_stripe = (
             (row_hash % np.uint64(l_eff)).astype(np.int64)
             == ((i + salt) % l_eff)
         )
-        elig = a.eligible & in_stripe
+        elig = base_elig & in_stripe
         # the stripe must still hold 2× the lane's ask in feasible slots
-        ok = float(jn[in_stripe].sum()) >= 2 * a.count and int(
+        ok = float(jn_w[elig].sum()) >= 2 * a.count and int(
             elig.sum()
         ) >= 8
-        if ok and a.blocks is not None:
-            # the stripe must not silently amputate spread/cap values:
-            # every value reachable from the full eligible set must stay
-            # reachable from the stripe (rack-contiguous row orderings
-            # with racks smaller than the lane count would otherwise skew
-            # the spread with no error surfaced)
-            for b in range(a.blocks.num_blocks):
-                vids = a.blocks.value_ids[b]
-                full_vals = np.unique(vids[(vids >= 0) & a.eligible])
-                stripe_vals = np.unique(vids[(vids >= 0) & elig])
-                if full_vals.shape[0] != stripe_vals.shape[0]:
-                    ok = False
-                    break
-        out.append(replace(a, eligible=elig) if ok else a)
+        if ok:
+            ok = values_reachable(elig)
+        if ok:
+            _metrics.incr("nomad.kernel.lane_striped")
+            out.append(replace(a, eligible=elig))
+        elif base_elig is not a.eligible:
+            # stripe rejected but the worker slice is viable: keep
+            # cross-worker disjointness at least
+            _metrics.incr("nomad.kernel.lane_universe_only")
+            out.append(replace(a, eligible=base_elig))
+        else:
+            _metrics.incr("nomad.kernel.lane_full_set")
+            out.append(a)
     return out
 
 

@@ -75,7 +75,13 @@ def tainted_nodes(snapshot, allocs) -> dict:
 @register_scheduler("batch")
 class GenericScheduler:
     def __init__(
-        self, snapshot, planner: Planner, *, batch: bool = False, cache=None
+        self,
+        snapshot,
+        planner: Planner,
+        *,
+        batch: bool = False,
+        cache=None,
+        overlay=None,
     ):
         self.snapshot = snapshot
         self.planner = planner
@@ -84,6 +90,13 @@ class GenericScheduler:
         # worker threads share it); a private one here keeps standalone
         # scheduler construction working
         self.cache = cache if cache is not None else DeviceStateCache()
+        # server-shared optimistic overlay (server/overlay.py): single-
+        # eval processing runs CONCURRENTLY with pipelined batch commits
+        # (fallback evals execute inside commit threads), so an
+        # overlay-blind single pass seeds the very conflicts it was
+        # retrying — it must score against, and reserve into, the same
+        # in-flight accounting as the batched passes
+        self.overlay = overlay
         self.kernel: Optional[PlacementKernel] = None
         self.eval: Optional[Evaluation] = None
         self.job = None
@@ -135,23 +148,44 @@ class GenericScheduler:
         if placements and self.job is not None:
             ct, tg_order = self._build_group_asks(placements)
             asks = [t[3] for t in tg_order]
-            results = self.kernel.place(ct, asks)
-            # the repair walk is also the single-eval safety net: it
-            # resolves cross-TG conflicts within this plan and re-places
-            # kernel shortfalls (e.g. chunked-path truncation) by exact
-            # host re-score before they read as placement failures
-            from ..device.score import repair_batch_conflicts
+            used_override = None
+            if self.overlay is not None:
+                used_override = self.overlay.begin_pass(ct)
+            try:
+                results = self.kernel.place(
+                    ct, asks, used_override=used_override
+                )
+                # the repair walk is also the single-eval safety net: it
+                # resolves cross-TG conflicts within this plan and
+                # re-places kernel shortfalls (e.g. chunked-path
+                # truncation) by exact host re-score before they read as
+                # placement failures
+                from ..device.score import repair_batch_conflicts
 
-            repair_batch_conflicts(
-                ct, asks, results,
-                algorithm_spread=self.kernel.algorithm_spread,
-                # single-eval: no fresh state to re-run against, so an
-                # unplaceable placement fails into the blocked-eval
-                # accounting instead of aborting the lane
-                fail_on_contention=True,
-            )
-            self._finish_placements(ct, tg_order, results)
-            self._adjust_queued()
+                repair_batch_conflicts(
+                    ct, asks, results,
+                    algorithm_spread=self.kernel.algorithm_spread,
+                    # single-eval: no fresh state to re-run against, so
+                    # an unplaceable placement fails into the blocked-
+                    # eval accounting instead of aborting the lane
+                    fail_on_contention=True,
+                    used_override=used_override,
+                )
+                if self.overlay is not None:
+                    for a, res in zip(asks, results):
+                        rows = res.node_rows[res.node_rows >= 0]
+                        if rows.size:
+                            self.overlay.add_delta(ct, rows, a.ask)
+                self._finish_placements(ct, tg_order, results)
+                self._adjust_queued()
+                # the pass marker is held through plan SUBMISSION: once
+                # released with the commit not yet applied, a concurrent
+                # worker's maybe_reset() could drop the overlay while
+                # these placements are still only predictions
+                return self._submit_attempt()
+            finally:
+                if self.overlay is not None:
+                    self.overlay.pass_finished()
         return self._submit_attempt()
 
     # -- batched multi-eval pass (SURVEY.md §7 step 5) --------------------

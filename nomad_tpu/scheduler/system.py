@@ -35,7 +35,14 @@ MAX_SYSTEM_SCHEDULE_ATTEMPTS = 5  # scheduler_system.go:12-21
 @register_scheduler("sysbatch")
 class SystemScheduler:
     def __init__(
-        self, snapshot, planner: Planner, *, sysbatch: bool = False, cache=None
+        self,
+        snapshot,
+        planner: Planner,
+        *,
+        sysbatch: bool = False,
+        cache=None,
+        overlay=None,  # accepted for factory uniformity; system placement
+        # is per-node (no greedy packing), so the overlay isn't consulted
     ):
         self.snapshot = snapshot
         self.planner = planner

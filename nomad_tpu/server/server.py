@@ -88,6 +88,11 @@ class Server:
         from ..device.cache import DeviceStateCache
 
         self.device_cache = DeviceStateCache()
+        # cross-worker optimistic usage for pipelined batched passes
+        # (server/overlay.py)
+        from .overlay import SharedOverlay
+
+        self.placement_overlay = SharedOverlay()
         self._raft_lock = threading.Lock()
         self._leader = False
         from ..broker.event_broker import EventBroker as StreamBroker

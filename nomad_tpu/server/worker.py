@@ -48,11 +48,20 @@ SCHEDULER_TYPES = ["service", "batch", "system", "sysbatch", "_core"]
 # keep wide batches conflict-free.
 #
 # Workers 0..num_batch_workers-1 run batched passes, each on a disjoint
-# JOB-HASH PARTITION of the eval stream (broker n_partitions) with its
-# own lane-stripe salt — r3 measured a 0.46 conflict rate with two
-# batching workers sharing one stream; partitioning plus per-worker
-# striping removes the shared hot set. Remaining workers drain solo
-# evals, overlapping host-side reconcile/flatten with the device passes.
+# JOB-HASH PARTITION of the eval stream (broker n_partitions), a disjoint
+# hashed NODE UNIVERSE, and its own lane-stripe salt — r3 measured a
+# 0.46 conflict rate with two batching workers sharing one stream;
+# partitioning removes the shared hot set (measured 6.8× single-worker
+# eval throughput with conflict 0 at the 8-deep repro shape). Remaining
+# workers drain solo evals through the same shared optimistic overlay.
+#
+# Concurrency caveat, measured honestly: on a SINGLE-core host at the
+# 10k-node config-3 shape, any second worker (solo or batching) races
+# the pipelined commits under CPU starvation and conflict rates swing
+# run-to-run (0.0–0.96); one pipelined batching worker is bit-stable
+# there (conflict 0.0 across every instrumented run). The bench pins
+# num_workers=1 for reproducibility; multi-worker batching is for
+# multi-core servers.
 EVAL_BATCH_SIZE = 64
 
 
@@ -101,22 +110,11 @@ class Worker:
         # bare dict increments would lose counts across the interleave
         self.stats = {"processed": 0, "acked": 0, "nacked": 0}
         self._stats_lock = threading.Lock()
-        # Pipelining state (batch worker only). The optimistic overlay is
-        # EPOCH-based: ct.used is refreshed in place by the device cache
-        # as the previous pass's plans commit, so "ct.used + overlay"
-        # double-counts whatever already landed. Instead the epoch pins a
-        # COPY of used taken when the pipeline went in-flight; every
-        # in-flight pass's placements accumulate into the epoch delta,
-        # and the epoch resets (fresh copy, zero delta) whenever the
-        # commit thread has fully drained.
+        # Pipelining state (batch worker only): this worker's in-flight
+        # commit thread. Optimistic usage accounting lives in the
+        # SERVER-SHARED overlay (server/overlay.py) so concurrent
+        # batching workers see each other's in-flight placements too.
         self._commit_thread: Optional[threading.Thread] = None
-        self._epoch_used: Optional[np.ndarray] = None  # frozen [pn, D]
-        self._epoch_delta: Optional[np.ndarray] = None  # in-flight sum
-        # row-layout generation the epoch's indices align with: tensors()
-        # returns a fresh wrapper object per call, so OBJECT identity is
-        # useless — layout_gen changes only on a full reflatten (the only
-        # event that reorders rows)
-        self._epoch_layout_gen: int = -1
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -215,24 +213,18 @@ class Worker:
         """Run a batch of evals through one combined device pass, then
         hand the commit to the pipeline thread and return — the NEXT
         pass's prepare + device time overlaps this pass's commit."""
-        # Pipeline state must be decided BEFORE the snapshot: if the
-        # previous commit finished between snapshot and the check, the
-        # snapshot would miss its writes while the epoch (and its
-        # overlay) had already been dropped — this pass would then score
-        # against stale usage and overbook (measured as a full pass of
-        # applier partial-commit fallbacks). Checking first makes the
-        # race benign: a commit finishing right after the check leaves
-        # the epoch active, which merely over-reserves.
-        commit_busy = (
-            self._commit_thread is not None
-            and self._commit_thread.is_alive()
-        )
-        if not commit_busy:
-            self._join_commit()  # reap the finished thread
+        # Reap a finished commit thread and (only when NOTHING is in
+        # flight anywhere) reset the shared overlay epoch — strictly
+        # BEFORE the snapshot, so the snapshot taken next is guaranteed
+        # to include everything the dropped overlay was predicting
+        # (resetting from the commit thread let the next pass freeze a
+        # pre-commit base and cascade into applier rejections).
+        if self._commit_thread is not None and (
+            not self._commit_thread.is_alive()
+        ):
+            self._join_commit()
+        if self.server.placement_overlay.maybe_reset():
             metrics.incr("nomad.worker.pipeline_epoch_resets")
-            self._epoch_used = None
-            self._epoch_delta = None
-            self._epoch_layout_gen = -1
         with metrics.timer("nomad.worker.wait_for_index"):
             self.server.store.wait_for_index(
                 max(ev.modify_index for ev, _ in batch), timeout=5.0
@@ -259,6 +251,7 @@ class Worker:
                 snapshot,
                 _TokenPlanner(self, token),
                 cache=self.server.device_cache,
+                overlay=self.server.placement_overlay,
             )
             try:
                 asks = sched.prepare_batch_attempt(ev, ct=ct)
@@ -278,28 +271,14 @@ class Worker:
         results = None
         lane_ok: list[bool] = []
         if all_asks:
-            # Optimistic overlay: the previous pass's placements are not
-            # committed yet (its commit thread is running) but the applier
-            # WILL land most of them — scoring this pass against bare
-            # ct.used would double-book those nodes, while ct.used PLUS
-            # the raw delta double-counts whatever the cache already
-            # refreshed in. Epoch accounting keeps it consistent: a used
-            # copy frozen when the pipeline went in-flight, plus every
-            # in-flight pass's delta.
-            used_override = None
-            if (
-                self._epoch_used is not None
-                and self._epoch_layout_gen != ct.layout_gen
-            ):
-                # full reflatten changed row order mid-epoch: the frozen
-                # base no longer aligns — drop the overlay (the applier
-                # remains the authority on any resulting double-booking)
-                self._epoch_used = None
-                self._epoch_delta = None
-                self._epoch_layout_gen = -1
-            if self._epoch_used is not None:
+            # Optimistic overlay: in-flight passes (this worker's AND
+            # other batching workers') are not committed yet, but the
+            # applier WILL land most of them — scoring against bare
+            # ct.used would double-book those nodes (server/overlay.py).
+            overlay = self.server.placement_overlay
+            used_override = overlay.begin_pass(ct)
+            if used_override is not None:
                 metrics.incr("nomad.worker.pipeline_override_passes")
-                used_override = self._epoch_used + self._epoch_delta
             try:
                 kernel = prepared[0][2].kernel
                 with metrics.timer("nomad.worker.invoke_scheduler"):
@@ -312,6 +291,10 @@ class Worker:
                         all_asks,
                         decorrelate=True,
                         decorrelate_salt=self.id,
+                        # concurrent batchers carve disjoint node slices
+                        decorrelate_workers=getattr(
+                            self.server.config, "num_batch_workers", 1
+                        ),
                         overflow=32,
                         used_override=used_override,
                     )
@@ -336,34 +319,42 @@ class Worker:
                 singles.extend((ev, token) for ev, token, _, _ in prepared)
                 prepared = []
                 results = None
-
-        # accumulate THIS pass's submitted placements into the epoch
-        # delta for the next pass's optimistic base
-        if results is not None and prepared:
-            if self._epoch_used is None:
-                # epoch starts now: freeze the usage this pass scored
-                # against (a fresh epoch always scores on bare ct.used)
-                self._epoch_used = np.asarray(ct.used).copy()
-                self._epoch_delta = np.zeros_like(self._epoch_used)
-                self._epoch_layout_gen = ct.layout_gen
-            delta = self._epoch_delta
-            off = 0
-            for _ev, _tok, _sched, n in prepared:
-                span_ok = all(lane_ok[off : off + n])
-                for lane in range(off, off + n):
-                    if not span_ok:
-                        continue
-                    a = all_asks[lane]
-                    rows = results[lane].node_rows
-                    rows = rows[rows >= 0]
-                    if rows.size:
-                        np.add.at(delta, rows, a.ask)
-                off += n
+            finally:
+                # Reserve THIS pass's submitted placements in the shared
+                # overlay, take the COMMIT marker, and only then release
+                # the pass marker: a gap between the two markers would
+                # let another worker's maybe_reset() drop the overlay
+                # while these placements are neither "in a pass" nor "in
+                # a commit" — exactly the dropped-reservation cascade the
+                # reset discipline exists to prevent. The commit thread
+                # below runs unconditionally, releasing the marker.
+                try:
+                    if results is not None and prepared:
+                        off = 0
+                        for _ev, _tok, _sched, n in prepared:
+                            span_ok = all(lane_ok[off : off + n])
+                            for lane in range(off, off + n):
+                                if not span_ok:
+                                    continue
+                                a = all_asks[lane]
+                                rows = results[lane].node_rows
+                                rows = rows[rows >= 0]
+                                if rows.size:
+                                    overlay.add_delta(ct, rows, a.ask)
+                            off += n
+                finally:
+                    self.server.placement_overlay.commit_started()
+                    overlay.pass_finished()
 
         # pipeline: the previous commit must finish before this pass's
         # commit starts (plan order per job; one in-flight commit bounds
         # memory), but the NEXT device pass overlaps THIS commit.
         self._join_commit()
+        if not all_asks:
+            # the marker is taken in the device-pass block; a batch with
+            # no kernel work (all singles) still needs it for the commit
+            # thread's finally to balance
+            self.server.placement_overlay.commit_started()
         args = (prepared, all_asks, results, lane_ok, singles)
         self._commit_thread = threading.Thread(
             target=self._commit_batch, args=args,
@@ -377,6 +368,16 @@ class Worker:
         """Commit one finished pass: per-eval plan submission + ack/nack.
         Runs on the commit thread while the worker's next device pass is
         in flight."""
+        try:
+            self._commit_batch_inner(
+                prepared, all_asks, results, lane_ok, singles
+            )
+        finally:
+            self.server.placement_overlay.commit_finished()
+
+    def _commit_batch_inner(
+        self, prepared, all_asks, results, lane_ok, singles
+    ) -> None:
         try:
             off = 0
             for ev, token, sched, n in prepared:
@@ -441,6 +442,7 @@ class Worker:
             snapshot,
             planner if planner is not None else _TokenPlanner(self, ""),
             cache=self.server.device_cache,
+            overlay=self.server.placement_overlay,
         )
         with metrics.timer("nomad.worker.invoke_scheduler"):
             sched.process(ev)
