@@ -26,6 +26,7 @@ class DevAgent:
         host_volumes: Optional[dict] = None,
         driver_mode: str = "inprocess",
         device_plugins: Optional[list] = None,
+        csi_plugins: Optional[list] = None,
     ):
         self.data_dir = data_dir or tempfile.mkdtemp(prefix="nomad-tpu-dev-")
         self.server = Server(
@@ -38,6 +39,7 @@ class DevAgent:
             host_volumes=host_volumes,
             driver_mode=driver_mode,
             device_plugins=device_plugins,
+            csi_plugins=csi_plugins,
         )
 
     def start(self) -> None:

@@ -42,6 +42,8 @@ class AllocRunner:
         prev_watcher: Optional[Callable] = None,
         device_plugins: Optional[dict] = None,
         device_group_owner: Optional[dict] = None,
+        csi_plugins: Optional[dict] = None,
+        csi_volume_resolver: Optional[Callable] = None,
     ):
         self.alloc = alloc
         self.drivers = drivers
@@ -58,6 +60,14 @@ class AllocRunner:
         # plus the (vendor, type, name) → plugin-name ownership map
         self.device_plugins = device_plugins or {}
         self.device_group_owner = device_group_owner or {}
+        # CSI plugin clients (name → CSIPluginClient) for the
+        # stage/publish lifecycle; published (plugin, volume_id, target)
+        # triples recorded for teardown
+        self.csi_plugins = csi_plugins or {}
+        # volume_id -> (resolved_id, plugin_id) via the server (routing +
+        # per_alloc fallback); None in plugin-less/standalone setups
+        self.csi_volume_resolver = csi_volume_resolver
+        self._published_volumes: list[tuple] = []
         self.task_runners: dict[str, TaskRunner] = {}
         self.task_states: dict[str, TaskState] = {}
         self._lock = threading.Lock()
@@ -83,6 +93,7 @@ class AllocRunner:
         os.makedirs(env["NOMAD_ALLOC_DIR"], exist_ok=True)
         try:
             env.update(self._reserve_devices())
+            env.update(self._publish_csi_volumes(tg))
         except RuntimeError as e:
             log.warning("alloc %s: %s", self.alloc.id[:8], e)
             self._report(ALLOC_CLIENT_FAILED, str(e))
@@ -156,6 +167,102 @@ class AllocRunner:
             envs.update(res.get("envs") or {})
         return envs
 
+    def _publish_csi_volumes(self, tg) -> dict:
+        """Stage + publish each CSI volume request through the plugin
+        that OWNS it (csimanager/volume.go's NodeStage→NodePublish half;
+        the server's claim lifecycle already gated scheduling). The
+        volume resolves through the server (``csi_volume_info``) so the
+        published id and the claimed id agree — including the per_alloc
+        fallback to the base source the scheduler and applier use. The
+        published path is exposed at <alloc_dir>/volumes/<name> and as
+        NOMAD_VOLUME_<NAME> in every task's env. Failures FAIL the alloc
+        (with staged-but-unpublished volumes unstaged and earlier
+        publishes torn down) — running without a declared volume is the
+        reference's failure mode too."""
+        volumes = getattr(tg, "volumes", None) or {}
+        csi_reqs = {
+            name: req
+            for name, req in volumes.items()
+            if getattr(req, "type", "") == "csi"
+        }
+        if not csi_reqs:
+            return {}
+        if not self.csi_plugins:
+            raise RuntimeError(
+                "alloc requests CSI volumes but no CSI plugin is available"
+            )
+        envs: dict = {}
+        staging_root = os.path.join(self.alloc_dir, "csi-staging")
+        try:
+            for name, req in csi_reqs.items():
+                vol_id = req.source
+                if getattr(req, "per_alloc", False):
+                    vol_id = f"{req.source}[{self.alloc.index()}]"
+                plugin_id = None
+                if self.csi_volume_resolver is not None:
+                    info = self.csi_volume_resolver(vol_id)
+                    if info is not None:
+                        # server-resolved id (per_alloc falls back to the
+                        # base source exactly like scheduling/apply did)
+                        vol_id, plugin_id = info
+                if plugin_id is not None:
+                    plugin = self.csi_plugins.get(plugin_id)
+                    if plugin is None:
+                        raise RuntimeError(
+                            f"volume {vol_id} needs CSI plugin "
+                            f"{plugin_id!r}, which this node does not run"
+                        )
+                elif len(self.csi_plugins) == 1:
+                    plugin = next(iter(self.csi_plugins.values()))
+                else:
+                    raise RuntimeError(
+                        f"cannot route volume {vol_id}: no resolver and "
+                        f"{len(self.csi_plugins)} plugins configured"
+                    )
+                target = os.path.join(self.alloc_dir, "volumes", name)
+                staged = False
+                try:
+                    plugin.node_stage(
+                        vol_id, os.path.join(staging_root, name)
+                    )
+                    staged = True
+                    plugin.node_publish(
+                        vol_id, target,
+                        read_only=getattr(req, "read_only", False),
+                    )
+                except Exception as e:
+                    if staged:
+                        # stage succeeded, publish failed: a real driver
+                        # would leak the staged mount otherwise
+                        try:
+                            plugin.node_unstage(vol_id)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    raise RuntimeError(
+                        f"csi volume {name} ({vol_id}): {e}"
+                    ) from e
+                self._published_volumes.append((plugin, vol_id, target))
+                envs[
+                    f"NOMAD_VOLUME_{name.upper().replace('-', '_')}"
+                ] = target
+        except RuntimeError:
+            # tear down whatever already published for this alloc — a
+            # failed alloc must not hold volumes mounted
+            self._unpublish_csi_volumes()
+            raise
+        return envs
+
+    def _unpublish_csi_volumes(self) -> None:
+        for plugin, vol_id, target in self._published_volumes:
+            try:
+                plugin.node_unpublish(vol_id, target)
+                plugin.node_unstage(vol_id)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                log.warning(
+                    "csi unpublish failed for %s", vol_id, exc_info=True
+                )
+        self._published_volumes = []
+
     def _migrate_previous(self, tg) -> None:
         """Previous-alloc data migration (client/allocwatcher +
         migrate_hook): with ephemeral_disk.migrate/sticky, wait for the
@@ -191,6 +298,7 @@ class AllocRunner:
         """Graceful stop (desired_status=stop): leader-last kill order."""
         for tr in self.task_runners.values():
             tr.kill()
+        self._unpublish_csi_volumes()
         self._report(self.client_status(), "alloc stopped")
 
     def destroy(self) -> None:

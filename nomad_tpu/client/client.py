@@ -62,6 +62,7 @@ class Client:
         serve_endpoints: bool = True,
         driver_mode: str = "inprocess",
         device_plugins: Optional[list[str]] = None,
+        csi_plugins: Optional[list[str]] = None,
     ):
         self.rpc = rpc
         self.data_dir = data_dir
@@ -108,6 +109,28 @@ class Client:
                     sum(len(g.instances) for g in groups)
                 )
                 self.node.compute_class()
+        # out-of-process CSI plugins (plugins/csi analog — see
+        # client/csi_plugin.py): the alloc runner drives NodeStage/
+        # NodePublish through them around task execution
+        self.csi_plugins: dict[str, object] = {}
+        for cp_name in csi_plugins or []:
+            from .csi_plugin import CSIPluginClient
+
+            cp = CSIPluginClient(cp_name)
+            if cp.probe():
+                from ..structs.volumes import CSINodeInfo
+
+                self.csi_plugins[cp_name] = cp
+                # the structured node surface the scheduler's
+                # CSIVolumeChecker reads (Node.CSINodePlugins)
+                self.node.csi_node_plugins[cp_name] = CSINodeInfo(
+                    plugin_id=cp_name, healthy=True
+                )
+                self.node.attributes[f"csi.{cp_name}"] = "1"
+                self.node.compute_class()
+            else:
+                log.warning("csi plugin %s failed probe", cp_name)
+                cp.close()
         if host_volumes:
             # client config host_volume blocks surface on the node for the
             # HostVolumeChecker (structs.ClientHostVolumeConfig)
@@ -182,7 +205,9 @@ class Client:
             close = getattr(d, "close", None)
             if close is not None:
                 close()
-        for dp in self.device_plugins.values():
+        for dp in list(self.device_plugins.values()) + list(
+            self.csi_plugins.values()
+        ):
             try:
                 dp.close()
             except Exception:  # noqa: BLE001 — shutdown is best-effort
@@ -211,6 +236,8 @@ class Client:
                 on_handle=self.state_db.put_handle,
                 device_plugins=self.device_plugins,
                 device_group_owner=self.device_group_owner,
+                csi_plugins=self.csi_plugins,
+                csi_volume_resolver=self._csi_volume_resolver,
             )
             with self._lock:
                 self.runners[alloc.id] = runner
@@ -218,6 +245,19 @@ class Client:
                 target=runner.run, name=f"alloc-{alloc.id[:8]}", daemon=True
             ).start()
             self._maybe_track_health(runner)
+
+    def _csi_volume_resolver(self, volume_id: str):
+        """Server-side volume resolution for CSI publish routing (the
+        Node->CSIVolume.Get hop); None when the transport lacks it or the
+        volume is unknown."""
+        fn = getattr(self.rpc, "csi_volume_info", None)
+        if fn is None:
+            return None
+        try:
+            return fn(volume_id)
+        except Exception:  # noqa: BLE001 — routing falls back
+            log.warning("csi volume resolve failed", exc_info=True)
+            return None
 
     # -- heartbeats --------------------------------------------------------
     def _heartbeat_loop(self) -> None:
@@ -408,6 +448,8 @@ class Client:
                 prev_watcher=self._watch_previous_alloc,
                 device_plugins=self.device_plugins,
                 device_group_owner=self.device_group_owner,
+                csi_plugins=self.csi_plugins,
+                csi_volume_resolver=self._csi_volume_resolver,
             )
             with self._lock:
                 self.runners[alloc_id] = runner

@@ -27,14 +27,12 @@ from __future__ import annotations
 
 import json
 import os
-import select
-import subprocess
 import sys
 import threading
-import time
 from typing import Optional
 
 from ..structs.resources import NodeDeviceInstance, NodeDeviceResource
+from .stdio_plugin import StdioPluginClient
 
 DEVICE_PLUGIN_MAGIC = "NOMAD_TPU_DEVICE_V1"
 DEVICE_PROTO_VERSION = 1
@@ -211,79 +209,16 @@ def serve_device_plugin(plugin: DevicePlugin, stdin=None, stdout=None):
 # -- host (client) side ------------------------------------------------------
 
 
-class DevicePluginClient:
+class DevicePluginClient(StdioPluginClient):
     """Spawns and drives one device plugin subprocess."""
 
-    def __init__(self, name: str, argv: Optional[list[str]] = None):
-        self.name = name
-        self._argv = argv or [
+    MAGIC = DEVICE_PLUGIN_MAGIC
+    VERSION = DEVICE_PROTO_VERSION
+
+    def default_argv(self, name: str) -> list[str]:
+        return [
             sys.executable, "-m", "nomad_tpu.client.device_plugin", name,
         ]
-        self._proc: Optional[subprocess.Popen] = None
-        self._lock = threading.Lock()
-        self._next_id = 0
-
-    def _ensure(self) -> None:
-        with self._lock:
-            if self._proc is not None and self._proc.poll() is None:
-                return
-            self._proc = subprocess.Popen(
-                self._argv,
-                stdin=subprocess.PIPE,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.DEVNULL,
-                text=True,
-                env={**os.environ, "PYTHONPATH": os.pathsep.join(sys.path)},
-            )
-            # bounded handshake (same hazard as driver plugins: a hung
-            # plugin must not wedge the fingerprint pass)
-            deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
-            fd = self._proc.stdout.fileno()
-            buf = b""
-            while b"\n" not in buf:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    self._proc.kill()
-                    self._proc.wait()
-                    raise RuntimeError(
-                        f"device plugin {self.name!r} handshake timeout"
-                    )
-                ready, _, _ = select.select([fd], [], [], remaining)
-                if not ready:
-                    continue
-                chunk = os.read(fd, 4096)
-                if not chunk:
-                    break
-                buf += chunk
-            hs = json.loads(buf.partition(b"\n")[0] or b"{}")
-            if (
-                hs.get("magic") != DEVICE_PLUGIN_MAGIC
-                or hs.get("version") != DEVICE_PROTO_VERSION
-            ):
-                self._proc.kill()
-                raise RuntimeError(
-                    f"device plugin handshake failed: {hs!r}"
-                )
-
-    def _call(self, method: str, params: Optional[dict] = None):
-        self._ensure()
-        with self._lock:
-            self._next_id += 1
-            rid = self._next_id
-            self._proc.stdin.write(
-                json.dumps(
-                    {"id": rid, "method": method, "params": params or {}}
-                )
-                + "\n"
-            )
-            self._proc.stdin.flush()
-            line = self._proc.stdout.readline()
-        if not line:
-            raise RuntimeError(f"device plugin {self.name!r} exited")
-        msg = json.loads(line)
-        if msg.get("error"):
-            raise RuntimeError(msg["error"])
-        return msg.get("result")
 
     # -- contract ----------------------------------------------------------
     def fingerprint(self) -> list[NodeDeviceResource]:
@@ -312,21 +247,6 @@ class DevicePluginClient:
 
     def stats(self) -> dict:
         return self._call("stats") or {}
-
-    def close(self) -> None:
-        p = self._proc
-        if p is None:
-            return
-        try:
-            self._call("shutdown")
-        except Exception:  # noqa: BLE001
-            pass
-        try:
-            p.terminate()
-            p.wait(timeout=2)
-        except Exception:  # noqa: BLE001
-            p.kill()
-        self._proc = None
 
 
 def _main() -> None:

@@ -1,0 +1,125 @@
+"""Shared NDJSON-stdio plugin transport (host side).
+
+The device (client/device_plugin.py) and CSI (client/csi_plugin.py)
+plugin clients speak the same wire: spawn a subprocess, read one
+handshake line under a deadline, then serial request/response JSON
+lines. This base owns that machinery once — transport fixes (handshake
+deadlines, zombie reaping, respawn) apply everywhere. The DRIVER plugin
+client (client/plugin.py) keeps its own pipelined transport: it
+multiplexes long-blocking calls (wait) concurrently, which this serial
+base deliberately does not."""
+
+from __future__ import annotations
+
+import json
+import os
+import select
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+HANDSHAKE_TIMEOUT_S = 10.0
+
+
+class StdioPluginClient:
+    """Serial request/response client over a plugin subprocess's stdio."""
+
+    #: subclasses set these
+    MAGIC = ""
+    VERSION = 0
+
+    def __init__(self, name: str, argv: Optional[list[str]] = None):
+        self.name = name
+        self._argv = argv or self.default_argv(name)
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def default_argv(self, name: str) -> list[str]:  # pragma: no cover
+        raise NotImplementedError
+
+    def _ensure(self) -> None:
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                return
+            self._proc = subprocess.Popen(
+                self._argv,
+                stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+                text=True,
+                env={**os.environ, "PYTHONPATH": os.pathsep.join(sys.path)},
+            )
+            # bounded handshake covering partial lines: a hung or
+            # misbehaving plugin must not wedge the caller
+            deadline = time.monotonic() + HANDSHAKE_TIMEOUT_S
+            fd = self._proc.stdout.fileno()
+            buf = b""
+            while b"\n" not in buf:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._proc.kill()
+                    self._proc.wait()
+                    raise RuntimeError(
+                        f"plugin {self.name!r} handshake timeout"
+                    )
+                ready, _, _ = select.select([fd], [], [], remaining)
+                if not ready:
+                    continue
+                chunk = os.read(fd, 4096)
+                if not chunk:
+                    break
+                buf += chunk
+            hs = json.loads(buf.partition(b"\n")[0] or b"{}")
+            if hs.get("magic") != self.MAGIC or (
+                hs.get("version") != self.VERSION
+            ):
+                self._proc.kill()
+                self._proc.wait()  # reap — no zombie on mismatch
+                raise RuntimeError(
+                    f"plugin {self.name!r} handshake failed: {hs!r}"
+                )
+
+    def _call(self, method: str, params: Optional[dict] = None):
+        self._ensure()
+        with self._lock:
+            self._next_id += 1
+            rid = self._next_id
+            self._proc.stdin.write(
+                json.dumps(
+                    {"id": rid, "method": method, "params": params or {}}
+                )
+                + "\n"
+            )
+            self._proc.stdin.flush()
+            line = self._proc.stdout.readline()
+        if not line:
+            raise RuntimeError(f"plugin {self.name!r} exited")
+        msg = json.loads(line)
+        if msg.get("error"):
+            raise RuntimeError(msg["error"])
+        return msg.get("result")
+
+    def close(self) -> None:
+        p = self._proc
+        if p is None:
+            return
+        if p.poll() is None:
+            # only a LIVE plugin gets the polite shutdown — calling
+            # _call() here would respawn a dead one just to kill it
+            try:
+                self._call("shutdown")
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            p.terminate()
+            p.wait(timeout=2)
+        except Exception:  # noqa: BLE001
+            p.kill()
+            try:
+                p.wait(timeout=2)
+            except Exception:  # noqa: BLE001
+                pass
+        self._proc = None

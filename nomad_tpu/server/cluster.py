@@ -224,6 +224,18 @@ class ClusterServer:
             "leader_addr": self.raft.leader_addr(),
         })
         self.rpc.register("Nomad.stats", lambda a: self.raft.stats())
+        self.rpc.register(
+            "Nomad.csi_volume_info", self._handle_csi_volume_info
+        )
+
+    def _handle_csi_volume_info(self, args):
+        from .server import InProcessClientRPC
+
+        return {
+            "info": InProcessClientRPC(self.server).csi_volume_info(
+                (args or {}).get("volume_id", "")
+            )
+        }
 
     def _make_handler(self, name: str):
         fn = getattr(self.server, name)
@@ -362,6 +374,13 @@ class RemoteClientRPC:
         self._call(
             "Nomad.update_allocs_from_client", {"updates": list(updates)}
         )
+
+    def csi_volume_info(self, volume_id: str):
+        resp = self._call(
+            "Nomad.csi_volume_info", {"volume_id": volume_id}
+        )
+        info = (resp or {}).get("info")
+        return tuple(info) if info else None
 
     def close(self) -> None:
         for c in self._clients.values():

@@ -645,3 +645,18 @@ class InProcessClientRPC:
 
     def update_allocs(self, updates) -> None:
         self.server.update_allocs_from_client(updates)
+
+    def csi_volume_info(self, volume_id: str):
+        """(resolved_volume_id, plugin_id) or None — the client's volume
+        resolver for CSI publish routing (CSIVolume.Get's role). The
+        caller may pass a per-alloc id (``source[idx]``); resolution
+        falls back to the base source exactly like the scheduler and the
+        plan applier do."""
+        store = self.server.store
+        vol = store.csi_volume_by_id(volume_id)
+        if vol is None and "[" in volume_id:
+            base = volume_id.split("[", 1)[0]
+            vol = store.csi_volume_by_id(base)
+        if vol is None:
+            return None
+        return vol.id, vol.plugin_id

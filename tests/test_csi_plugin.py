@@ -1,0 +1,197 @@
+"""Out-of-process CSI plugin contract (client/csi_plugin.py — the
+plugins/csi analog): handshake + stage/publish/unpublish over the stdio
+transport, the hostpath reference plugin, and the alloc-runner lifecycle
+(volume data persists across allocs; teardown unpublishes)."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.client.csi_plugin import CSIPluginClient
+from nomad_tpu.structs.volumes import VolumeRequest
+
+
+@pytest.fixture()
+def csi_root(tmp_path):
+    root = str(tmp_path / "csi-root")
+    old = os.environ.get("NOMAD_CSI_HOSTPATH_ROOT")
+    os.environ["NOMAD_CSI_HOSTPATH_ROOT"] = root
+    yield root
+    if old is None:
+        os.environ.pop("NOMAD_CSI_HOSTPATH_ROOT", None)
+    else:
+        os.environ["NOMAD_CSI_HOSTPATH_ROOT"] = old
+
+
+class TestCSIProtocol:
+    def test_probe_stage_publish_roundtrip(self, csi_root, tmp_path):
+        cp = CSIPluginClient("hostpath")
+        try:
+            assert cp.probe() is True
+            target = str(tmp_path / "mnt" / "vol0")
+            cp.node_stage("vol0", str(tmp_path / "staging"))
+            cp.node_publish("vol0", target)
+            # published path is live: writes land in the volume backend
+            with open(os.path.join(target, "data.txt"), "w") as f:
+                f.write("hello-csi")
+            assert (
+                open(os.path.join(csi_root, "vol0", "data.txt")).read()
+                == "hello-csi"
+            )
+            cp.node_unpublish("vol0", target)
+            assert not os.path.lexists(target)
+            cp.node_unstage("vol0")
+        finally:
+            cp.close()
+
+    def test_publish_unstaged_volume_fails(self, csi_root, tmp_path):
+        cp = CSIPluginClient("hostpath")
+        try:
+            with pytest.raises(RuntimeError):
+                cp.node_publish("ghost", str(tmp_path / "mnt" / "g"))
+        finally:
+            cp.close()
+
+    def test_unknown_plugin_rejected(self):
+        cp = CSIPluginClient("nonexistent")
+        assert cp.probe() is False
+
+
+class TestCSIAllocLifecycle:
+    def test_volume_data_persists_across_allocs(self, csi_root, tmp_path):
+        """The CSI raison d'être: alloc 1 writes into the volume, alloc 2
+        (a different alloc, later) reads it back — stage/publish through
+        the out-of-process plugin, teardown unpublishes."""
+        agent = DevAgent(
+            data_dir=str(tmp_path / "agent"), num_workers=1,
+            csi_plugins=["hostpath"],
+        )
+        agent.start()
+        try:
+            assert agent.client.node.attributes.get("csi.hostpath") == "1"
+            # the volume must be registered for the scheduler's
+            # CSIVolumeChecker (the claim lifecycle is server-side);
+            # multi-writer access so the reader need not wait for the
+            # writer's claim to be reaped by the volume watcher
+            from nomad_tpu.structs.volumes import (
+                ACCESS_MODE_MULTI_NODE_MULTI_WRITER,
+                CSIVolume,
+            )
+
+            agent.server.register_csi_volume(
+                CSIVolume(
+                    id="shared-vol", name="shared-vol",
+                    plugin_id="hostpath",
+                    access_mode=ACCESS_MODE_MULTI_NODE_MULTI_WRITER,
+                )
+            )
+
+            def vol_job(jid, script):
+                job = mock.job()
+                job.id = jid
+                tg = job.task_groups[0]
+                tg.count = 1
+                tg.volumes = {
+                    "data": VolumeRequest(
+                        name="data", type="csi", source="shared-vol"
+                    )
+                }
+                tg.tasks[0].driver = "raw_exec"
+                tg.tasks[0].config = {
+                    "command": "/bin/sh",
+                    "args": ["-c", script],
+                }
+                tg.tasks[0].resources.cpu = 50
+                tg.tasks[0].resources.memory_mb = 32
+                return job
+
+            agent.register_job(
+                vol_job("writer", 'echo persisted > "$NOMAD_VOLUME_DATA/x"')
+            )
+
+            def alloc_done(jid):
+                allocs = agent.store.allocs_by_job("default", jid)
+                return any(
+                    a.client_status == "complete" for a in allocs
+                )
+
+            deadline = time.time() + 30
+            while time.time() < deadline and not alloc_done("writer"):
+                time.sleep(0.1)
+            assert alloc_done("writer"), "writer alloc did not finish"
+            # data landed in the volume backend
+            assert (
+                open(os.path.join(csi_root, "shared-vol", "x"))
+                .read()
+                .strip()
+                == "persisted"
+            )
+
+            agent.register_job(
+                vol_job(
+                    "reader",
+                    'cat "$NOMAD_VOLUME_DATA/x" > "$NOMAD_ALLOC_DIR/copy"',
+                )
+            )
+            deadline = time.time() + 30
+            while time.time() < deadline and not alloc_done("reader"):
+                time.sleep(0.1)
+            assert alloc_done("reader"), "reader alloc did not finish"
+            r_alloc = next(
+                a
+                for a in agent.store.allocs_by_job("default", "reader")
+                if a.client_status == "complete"
+            )
+            runner = agent.client.runners[r_alloc.id]
+            copy = os.path.join(runner.alloc_dir, "shared", "copy")
+            assert open(copy).read().strip() == "persisted"
+        finally:
+            agent.shutdown()
+
+    def test_missing_plugin_fails_alloc(self, csi_root, tmp_path):
+        agent = DevAgent(
+            data_dir=str(tmp_path / "agent2"), num_workers=1,
+        )  # no csi plugins configured
+        agent.start()
+        try:
+            job = mock.job()
+            job.id = "no-plugin"
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.volumes = {
+                "data": VolumeRequest(
+                    name="data", type="csi", source="shared-vol"
+                )
+            }
+            tg.tasks[0].driver = "raw_exec"
+            tg.tasks[0].config = {"command": "/bin/true"}
+            tg.tasks[0].resources.cpu = 50
+            tg.tasks[0].resources.memory_mb = 32
+            from nomad_tpu.structs.volumes import CSIVolume
+
+            agent.server.register_csi_volume(
+                CSIVolume(
+                    id="shared-vol", name="shared-vol",
+                    plugin_id="hostpath",
+                )
+            )
+            agent.register_job(job)
+            # the node advertises no CSI plugin, so the SCHEDULER must
+            # filter it (feasible.py FILTER_CSI_PLUGIN) — the job parks
+            # as a blocked eval; nothing ever runs without its volume
+            deadline = time.time() + 30
+            blocked = False
+            while time.time() < deadline and not blocked:
+                blocked = any(
+                    e.status == "blocked"
+                    for e in agent.store.evals()
+                    if e.job_id == "no-plugin"
+                )
+                time.sleep(0.1)
+            assert blocked, "eval should block on the missing CSI plugin"
+            assert not agent.store.allocs_by_job("default", "no-plugin")
+        finally:
+            agent.shutdown()
