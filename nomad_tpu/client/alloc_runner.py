@@ -254,12 +254,19 @@ class AllocRunner:
 
     def _unpublish_csi_volumes(self) -> None:
         for plugin, vol_id, target in self._published_volumes:
+            # separate trys: a failed unpublish must not skip the
+            # unstage (that would leak the staged mount)
             try:
                 plugin.node_unpublish(vol_id, target)
-                plugin.node_unstage(vol_id)
             except Exception:  # noqa: BLE001 — teardown is best-effort
                 log.warning(
                     "csi unpublish failed for %s", vol_id, exc_info=True
+                )
+            try:
+                plugin.node_unstage(vol_id)
+            except Exception:  # noqa: BLE001
+                log.warning(
+                    "csi unstage failed for %s", vol_id, exc_info=True
                 )
         self._published_volumes = []
 

@@ -248,16 +248,20 @@ class Client:
 
     def _csi_volume_resolver(self, volume_id: str):
         """Server-side volume resolution for CSI publish routing (the
-        Node->CSIVolume.Get hop); None when the transport lacks it or the
-        volume is unknown."""
+        Node->CSIVolume.Get hop). None when the transport lacks the call
+        or the volume is unknown; a TRANSIENT RPC failure RAISES — the
+        alloc must fail and retry rather than silently publish an
+        unresolved id (which a hostpath-style plugin would materialize
+        as a fresh empty volume)."""
         fn = getattr(self.rpc, "csi_volume_info", None)
         if fn is None:
             return None
         try:
             return fn(volume_id)
-        except Exception:  # noqa: BLE001 — routing falls back
-            log.warning("csi volume resolve failed", exc_info=True)
-            return None
+        except Exception as e:  # noqa: BLE001
+            raise RuntimeError(
+                f"csi volume resolution failed for {volume_id}: {e}"
+            ) from e
 
     # -- heartbeats --------------------------------------------------------
     def _heartbeat_loop(self) -> None:

@@ -29,18 +29,15 @@ data between allocs in tests and single-node deployments.
 
 from __future__ import annotations
 
-import json
 import os
 import shutil
 import sys
-import threading
 from typing import Optional
 
-from .stdio_plugin import StdioPluginClient
+from .stdio_plugin import StdioPluginClient, serve_stdio_plugin
 
 CSI_PLUGIN_MAGIC = "NOMAD_TPU_CSI_V1"
 CSI_PROTO_VERSION = 1
-HANDSHAKE_TIMEOUT_S = 10.0
 
 
 class CSIPlugin:
@@ -134,63 +131,35 @@ BUILTIN_CSI_PLUGINS = {"hostpath": HostPathCSIPlugin}
 
 
 def serve_csi_plugin(plugin: CSIPlugin, stdin=None, stdout=None) -> None:
-    stdin = stdin or sys.stdin
-    stdout = stdout or sys.stdout
-    wlock = threading.Lock()
-
-    def send(obj: dict) -> None:
-        with wlock:
-            stdout.write(json.dumps(obj) + "\n")
-            stdout.flush()
-
-    send(
+    serve_stdio_plugin(
+        CSI_PLUGIN_MAGIC,
+        CSI_PROTO_VERSION,
+        plugin.name,
         {
-            "type": "handshake",
-            "magic": CSI_PLUGIN_MAGIC,
-            "version": CSI_PROTO_VERSION,
-            "plugin": plugin.name,
-        }
+            "probe": lambda p: plugin.probe(),
+            "controller_publish": lambda p: plugin.controller_publish(
+                p["volume_id"], p["node_id"]
+            ),
+            "controller_unpublish": lambda p: plugin.controller_unpublish(
+                p["volume_id"], p["node_id"]
+            ),
+            "node_stage": lambda p: plugin.node_stage(
+                p["volume_id"], p["staging_path"]
+            ),
+            "node_unstage": lambda p: plugin.node_unstage(
+                p["volume_id"]
+            ),
+            "node_publish": lambda p: plugin.node_publish(
+                p["volume_id"], p["target_path"],
+                bool(p.get("read_only")),
+            ),
+            "node_unpublish": lambda p: plugin.node_unpublish(
+                p["volume_id"], p["target_path"]
+            ),
+        },
+        stdin=stdin,
+        stdout=stdout,
     )
-    methods = {
-        "probe": lambda p: plugin.probe(),
-        "controller_publish": lambda p: plugin.controller_publish(
-            p["volume_id"], p["node_id"]
-        ),
-        "controller_unpublish": lambda p: plugin.controller_unpublish(
-            p["volume_id"], p["node_id"]
-        ),
-        "node_stage": lambda p: plugin.node_stage(
-            p["volume_id"], p["staging_path"]
-        ),
-        "node_unstage": lambda p: plugin.node_unstage(p["volume_id"]),
-        "node_publish": lambda p: plugin.node_publish(
-            p["volume_id"], p["target_path"], bool(p.get("read_only"))
-        ),
-        "node_unpublish": lambda p: plugin.node_unpublish(
-            p["volume_id"], p["target_path"]
-        ),
-    }
-    for line in stdin:
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            req = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        rid = req.get("id")
-        method = req.get("method", "")
-        if method == "shutdown":
-            send({"id": rid, "result": True})
-            return
-        fn = methods.get(method)
-        if fn is None:
-            send({"id": rid, "error": f"unknown method {method!r}"})
-            continue
-        try:
-            send({"id": rid, "result": fn(req.get("params") or {})})
-        except Exception as e:  # noqa: BLE001 — report, don't die
-            send({"id": rid, "error": str(e)})
 
 
 # -- host (client) side ------------------------------------------------------

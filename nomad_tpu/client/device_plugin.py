@@ -25,18 +25,15 @@ test fake), mirroring how driver plugins are spawned.
 
 from __future__ import annotations
 
-import json
 import os
 import sys
-import threading
 from typing import Optional
 
 from ..structs.resources import NodeDeviceInstance, NodeDeviceResource
-from .stdio_plugin import StdioPluginClient
+from .stdio_plugin import StdioPluginClient, serve_stdio_plugin
 
 DEVICE_PLUGIN_MAGIC = "NOMAD_TPU_DEVICE_V1"
 DEVICE_PROTO_VERSION = 1
-HANDSHAKE_TIMEOUT_S = 10.0
 
 
 class DevicePlugin:
@@ -160,50 +157,20 @@ BUILTIN_DEVICE_PLUGINS = {
 
 
 def serve_device_plugin(plugin: DevicePlugin, stdin=None, stdout=None):
-    stdin = stdin or sys.stdin
-    stdout = stdout or sys.stdout
-    wlock = threading.Lock()
-
-    def send(obj: dict) -> None:
-        with wlock:
-            stdout.write(json.dumps(obj) + "\n")
-            stdout.flush()
-
-    send(
+    serve_stdio_plugin(
+        DEVICE_PLUGIN_MAGIC,
+        DEVICE_PROTO_VERSION,
+        plugin.name,
         {
-            "type": "handshake",
-            "magic": DEVICE_PLUGIN_MAGIC,
-            "version": DEVICE_PROTO_VERSION,
-            "plugin": plugin.name,
-        }
+            "fingerprint": lambda p: plugin.fingerprint(),
+            "reserve": lambda p: plugin.reserve(
+                p.get("device_ids") or []
+            ),
+            "stats": lambda p: plugin.stats(),
+        },
+        stdin=stdin,
+        stdout=stdout,
     )
-    for line in stdin:
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            req = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        rid = req.get("id")
-        method = req.get("method", "")
-        params = req.get("params") or {}
-        try:
-            if method == "fingerprint":
-                result = plugin.fingerprint()
-            elif method == "reserve":
-                result = plugin.reserve(params.get("device_ids") or [])
-            elif method == "stats":
-                result = plugin.stats()
-            elif method == "shutdown":
-                send({"id": rid, "result": True})
-                return
-            else:
-                send({"id": rid, "error": f"unknown method {method!r}"})
-                continue
-            send({"id": rid, "result": result})
-        except Exception as e:  # noqa: BLE001 — report, don't die
-            send({"id": rid, "error": str(e)})
 
 
 # -- host (client) side ------------------------------------------------------

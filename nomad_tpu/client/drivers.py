@@ -579,6 +579,98 @@ class ExecDriver(RawExecDriver):
             pass
 
 
+class JavaDriver(ExecDriver):
+    """drivers/java — JVM workloads under the shared executor.
+
+    The reference driver (drivers/java/driver.go) synthesizes the java
+    argv from the task config and hands it to the isolated executor; so
+    does this one: ``jar_path`` (→ ``-jar``) or ``class``/``class_path``
+    (→ ``-cp``), plus ``jvm_options`` and ``args``. JVM heap defaults to
+    the task's memory ask (-Xmx) as the reference does."""
+
+    name = "java"
+
+    def fingerprint(self) -> bool:
+        return shutil.which("java") is not None
+
+    def start(self, task, env, task_dir) -> TaskHandle:
+        import copy
+
+        cfg = task.config or {}
+        java = shutil.which("java")
+        if java is None:
+            raise DriverError("java runtime not found")
+        # absolute path: the executor runs tasks with a scrubbed PATH
+        argv = [java]
+        res = getattr(task, "resources", None)
+        if res is not None and getattr(res, "memory_mb", 0):
+            # heap gets ~80% of the ask: the executor's cgroup limit is
+            # the FULL ask, and heap == limit leaves no room for
+            # metaspace/stacks — the kernel would SIGKILL instead of the
+            # JVM raising OutOfMemoryError
+            heap = max(64, int(res.memory_mb * 0.8))
+            argv.append(f"-Xmx{heap}m")
+        argv += list(cfg.get("jvm_options", []))
+        if cfg.get("jar_path"):
+            argv += ["-jar", cfg["jar_path"]]
+        elif cfg.get("class"):
+            if cfg.get("class_path"):
+                argv += ["-cp", cfg["class_path"]]
+            argv.append(cfg["class"])
+        else:
+            raise DriverError(
+                "java requires config['jar_path'] or config['class']"
+            )
+        argv += list(cfg.get("args", []))
+        synth = copy.copy(task)
+        synth.config = {"command": argv[0], "args": argv[1:]}
+        return super().start(synth, env, task_dir)
+
+
+class QemuDriver(ExecDriver):
+    """drivers/qemu — VM images under the shared executor.
+
+    The reference (drivers/qemu/driver.go) execs qemu-system-x86_64 with
+    the image, the task's memory ask, -nographic, and optional
+    accelerator/port args; the VM process is supervised exactly like any
+    exec task (the executor's cgroup/rlimit bounds apply to the VMM)."""
+
+    name = "qemu"
+    QEMU_BIN = "qemu-system-x86_64"
+
+    def fingerprint(self) -> bool:
+        return shutil.which(self.QEMU_BIN) is not None
+
+    def start(self, task, env, task_dir) -> TaskHandle:
+        import copy
+
+        cfg = task.config or {}
+        image = cfg.get("image_path")
+        if not image:
+            raise DriverError("qemu requires config['image_path']")
+        mem_mb = 512
+        res = getattr(task, "resources", None)
+        if res is not None and getattr(res, "memory_mb", 0):
+            mem_mb = int(res.memory_mb)
+        # guest RAM below the cgroup cap: the VMM's own overhead
+        # (~100-200MB) rides inside the same limit
+        guest_mb = max(128, mem_mb - 128)
+        qemu = shutil.which(self.QEMU_BIN)
+        if qemu is None:
+            raise DriverError(f"{self.QEMU_BIN} not found")
+        argv = [
+            qemu,  # absolute: the executor scrubs PATH
+            "-machine", "type=pc,accel=" + cfg.get("accelerator", "tcg"),
+            "-m", f"{guest_mb}M",
+            "-drive", f"file={image}",
+            "-nographic",
+        ]
+        argv += list(cfg.get("args", []))
+        synth = copy.copy(task)
+        synth.config = {"command": argv[0], "args": argv[1:]}
+        return super().start(synth, env, task_dir)
+
+
 def builtin_drivers() -> dict[str, TaskDriver]:
     """The in-process driver catalog (helper/pluginutils/catalog analog)."""
     from .container import ContainerDriver
@@ -590,5 +682,7 @@ def builtin_drivers() -> dict[str, TaskDriver]:
             RawExecDriver(),
             ExecDriver(),
             ContainerDriver(),
+            JavaDriver(),
+            QemuDriver(),
         )
     }

@@ -23,6 +23,58 @@ from typing import Optional
 HANDSHAKE_TIMEOUT_S = 10.0
 
 
+def serve_stdio_plugin(
+    magic: str,
+    version: int,
+    plugin_name: str,
+    methods: dict,
+    stdin=None,
+    stdout=None,
+) -> None:
+    """Plugin-side serve loop shared by the device and CSI plugins:
+    handshake line, then serial id/method/params dispatch with error
+    replies; ``shutdown`` exits. ``methods`` maps method name → callable
+    taking the params dict."""
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    wlock = threading.Lock()
+
+    def send(obj: dict) -> None:
+        with wlock:
+            stdout.write(json.dumps(obj) + "\n")
+            stdout.flush()
+
+    send(
+        {
+            "type": "handshake",
+            "magic": magic,
+            "version": version,
+            "plugin": plugin_name,
+        }
+    )
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        rid = req.get("id")
+        method = req.get("method", "")
+        if method == "shutdown":
+            send({"id": rid, "result": True})
+            return
+        fn = methods.get(method)
+        if fn is None:
+            send({"id": rid, "error": f"unknown method {method!r}"})
+            continue
+        try:
+            send({"id": rid, "result": fn(req.get("params") or {})})
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            send({"id": rid, "error": str(e)})
+
+
 class StdioPluginClient:
     """Serial request/response client over a plugin subprocess's stdio."""
 
@@ -72,7 +124,10 @@ class StdioPluginClient:
                 if not chunk:
                     break
                 buf += chunk
-            hs = json.loads(buf.partition(b"\n")[0] or b"{}")
+            try:
+                hs = json.loads(buf.partition(b"\n")[0] or b"{}")
+            except ValueError:
+                hs = {}  # garbage banner: fail the magic check below
             if hs.get("magic") != self.MAGIC or (
                 hs.get("version") != self.VERSION
             ):
@@ -97,7 +152,16 @@ class StdioPluginClient:
             line = self._proc.stdout.readline()
         if not line:
             raise RuntimeError(f"plugin {self.name!r} exited")
-        msg = json.loads(line)
+        try:
+            msg = json.loads(line)
+        except ValueError as e:
+            # a stray non-JSON line must surface through the transport's
+            # RuntimeError contract, not as a JSONDecodeError callers
+            # don't expect
+            raise RuntimeError(
+                f"plugin {self.name!r} sent invalid response: "
+                f"{line[:120]!r}"
+            ) from e
         if msg.get("error"):
             raise RuntimeError(msg["error"])
         return msg.get("result")

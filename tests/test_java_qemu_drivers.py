@@ -1,0 +1,120 @@
+"""Java + QEMU drivers (drivers/java, drivers/qemu analogs): argv
+synthesis from task config, fingerprint gating on binary presence, and
+the full exec lifecycle via PATH-faked runtimes (the image carries
+neither java nor qemu; the drivers are argv wrappers over the shared
+executor, which is exactly what the fakes validate)."""
+
+import os
+import stat
+
+import pytest
+
+from nomad_tpu.client.drivers import (
+    DriverError,
+    JavaDriver,
+    QemuDriver,
+)
+from nomad_tpu.structs import Task
+
+
+@pytest.fixture()
+def fake_runtimes(tmp_path, monkeypatch):
+    """Fake `java` and `qemu-system-x86_64` that record their argv."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    for name in ("java", "qemu-system-x86_64"):
+        p = bindir / name
+        p.write_text('#!/bin/sh\necho "$0 $@"\nexit 0\n')
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv(
+        "PATH", f"{bindir}:{os.environ.get('PATH', '')}"
+    )
+    return bindir
+
+
+def mktask(driver, config, memory_mb=128):
+    t = Task(name="t", driver=driver, config=config)
+    t.resources.memory_mb = memory_mb
+    t.resources.cpu = 100
+    return t
+
+
+class TestJavaDriver:
+    def test_fingerprint_requires_java(self, fake_runtimes):
+        assert JavaDriver().fingerprint() is True
+
+    def test_jar_argv_and_lifecycle(self, fake_runtimes, tmp_path):
+        d = JavaDriver()
+        h = d.start(
+            mktask(
+                "java",
+                {
+                    "jar_path": "/srv/app.jar",
+                    "jvm_options": ["-Dfoo=bar"],
+                    "args": ["serve", "--port=80"],
+                },
+                memory_mb=256,
+            ),
+            {},
+            str(tmp_path),
+        )
+        assert d.wait(h, timeout=10) == 0
+        out = (tmp_path / "t.stdout").read_text()
+        assert "-Xmx204m" in out  # 80% of the 256MB ask (cgroup headroom)
+        assert "-Dfoo=bar" in out
+        assert "-jar /srv/app.jar serve --port=80" in out
+
+    def test_class_argv(self, fake_runtimes, tmp_path):
+        d = JavaDriver()
+        h = d.start(
+            mktask(
+                "java",
+                {"class": "com.example.Main", "class_path": "/srv/lib"},
+            ),
+            {},
+            str(tmp_path),
+        )
+        assert d.wait(h, timeout=10) == 0
+        out = (tmp_path / "t.stdout").read_text()
+        assert "-cp /srv/lib com.example.Main" in out
+
+    def test_missing_jar_and_class_rejected(self, fake_runtimes, tmp_path):
+        with pytest.raises(DriverError):
+            JavaDriver().start(mktask("java", {}), {}, str(tmp_path))
+
+
+class TestQemuDriver:
+    def test_fingerprint(self, fake_runtimes):
+        assert QemuDriver().fingerprint() is True
+
+    def test_argv_and_lifecycle(self, fake_runtimes, tmp_path):
+        d = QemuDriver()
+        h = d.start(
+            mktask(
+                "qemu",
+                {
+                    "image_path": "/srv/vm.qcow2",
+                    "accelerator": "kvm",
+                    "args": ["-smp", "2"],
+                },
+                memory_mb=512,
+            ),
+            {},
+            str(tmp_path),
+        )
+        assert d.wait(h, timeout=10) == 0
+        out = (tmp_path / "t.stdout").read_text()
+        assert "type=pc,accel=kvm" in out
+        assert "-m 384M" in out  # ask minus 128MB VMM overhead
+        assert "file=/srv/vm.qcow2" in out
+        assert "-nographic" in out
+        assert "-smp 2" in out
+
+    def test_missing_image_rejected(self, fake_runtimes, tmp_path):
+        with pytest.raises(DriverError):
+            QemuDriver().start(mktask("qemu", {}), {}, str(tmp_path))
+
+    def test_fingerprint_false_without_binary(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PATH", str(tmp_path))  # empty dir
+        assert QemuDriver().fingerprint() is False
+        assert JavaDriver().fingerprint() is False
