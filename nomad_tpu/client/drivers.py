@@ -604,11 +604,12 @@ class JavaDriver(ExecDriver):
         argv = [java]
         res = getattr(task, "resources", None)
         if res is not None and getattr(res, "memory_mb", 0):
-            # heap gets ~80% of the ask: the executor's cgroup limit is
-            # the FULL ask, and heap == limit leaves no room for
-            # metaspace/stacks — the kernel would SIGKILL instead of the
-            # JVM raising OutOfMemoryError
-            heap = max(64, int(res.memory_mb * 0.8))
+            # heap gets ~80% of the ask, capped at ask−32MB: the
+            # executor's cgroup limit is the FULL ask, and heap == limit
+            # leaves no room for metaspace/stacks — the kernel would
+            # SIGKILL instead of the JVM raising OutOfMemoryError
+            mem = int(res.memory_mb)
+            heap = max(32, min(int(mem * 0.8), mem - 32))
             argv.append(f"-Xmx{heap}m")
         argv += list(cfg.get("jvm_options", []))
         if cfg.get("jar_path"):
@@ -653,8 +654,12 @@ class QemuDriver(ExecDriver):
         if res is not None and getattr(res, "memory_mb", 0):
             mem_mb = int(res.memory_mb)
         # guest RAM below the cgroup cap: the VMM's own overhead
-        # (~100-200MB) rides inside the same limit
-        guest_mb = max(128, mem_mb - 128)
+        # (~100-200MB) rides inside the same limit; small asks keep a
+        # proportional margin instead of a fixed floor that would eat
+        # the whole cap
+        guest_mb = (
+            mem_mb - 128 if mem_mb >= 256 else max(32, mem_mb // 2)
+        )
         qemu = shutil.which(self.QEMU_BIN)
         if qemu is None:
             raise DriverError(f"{self.QEMU_BIN} not found")
