@@ -561,10 +561,11 @@ def main():
                     # on true single-core CPU in r5: the r4 J-bucket
                     # coarsening was the regression (J padded to 96 where
                     # 80 suffices → 13.2k; restoring multiple-of-16
-                    # buckets → 21.6k, ABOVE r2's 18.7k on equal
-                    # hardware). The fix is in _j_bucket; TPU runs were
-                    # never affected at the headline shape (the kernel is
-                    # memory-bound on CPU, not on the TPU's HBM).
+                    # buckets → 18.9–21.4k, parity with r2's 18.9–21.0k
+                    # in interleaved A/B, ±10% box noise). The fix is in
+                    # _j_bucket; TPU runs were never affected at the
+                    # headline shape (the kernel is memory-bound on CPU,
+                    # not on the TPU's HBM).
                     "cpu_delta_note": (
                         "r4 CPU slide was the J-bucket coarsening "
                         "(J=96 where 80 suffices): interleaved true-CPU "

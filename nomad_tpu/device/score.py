@@ -1298,9 +1298,10 @@ class PlacementKernel:
         # J bound tightened by the kernel's own structure: each chunk
         # step picks DISTINCT nodes (the first pick and the one-per-value
         # segment picks are disjoint), so one node gains at most one
-        # instance per step — head_j never exceeds n_chunks. At config-3
-        # shape this cuts the [N, J] planes 4× (J 96 → 24): plane
-        # construction dominates the pass, so it's ~linear wall-clock.
+        # instance per step — head_j never exceeds n_chunks. At the
+        # config-3 shape this cuts the [N, J] planes ~3× (J 80 → 24):
+        # plane construction dominates the pass, so it's ~linear
+        # wall-clock.
         max_j = min(max_j, self._j_bucket(n_chunks + 1))
 
         batch["counts"] = np.minimum(
