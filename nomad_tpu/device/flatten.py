@@ -77,6 +77,13 @@ class ClusterTensors:
     # attribute → (value_ids i32[N], vocab dict) — lazily built columns for
     # spread/property attributes, owned by the cache generation
     attr_cache: dict = field(default_factory=dict)
+    # datacenter → ready-node count, filled lazily IN PLACE by the
+    # scheduler (AllocMetric.nodes_available). The dict OBJECT is shared
+    # by reference across the per-call used-copy wrappers (replace()
+    # copies field references), so one computation serves every eval of
+    # a cache generation; refresh/rebuild construct a fresh empty dict,
+    # which is exactly the staleness boundary.
+    dc_ready_counts: dict = field(default_factory=dict)
     # row-layout generation: bumped ONLY by a full reflatten (which may
     # re-sort rows); preserved across incremental refreshes and the
     # per-call used-copy. Consumers holding row-indexed overlays (the
