@@ -107,6 +107,10 @@ class HTTPAgent:
             (re.compile(r"^/v1/plugins$"), self.handle_plugins),
             (re.compile(r"^/v1/allocations$"), self.handle_allocs),
             (
+                re.compile(r"^/v1/allocation/(?P<alloc_id>[^/]+)/stop$"),
+                self.handle_alloc_stop,
+            ),
+            (
                 re.compile(r"^/v1/allocation/(?P<alloc_id>[^/]+)$"),
                 self.handle_alloc,
             ),
@@ -751,6 +755,28 @@ class HTTPAgent:
             raise APIError(404, f"eval {eval_id} not found")
         self._enforce_obj_ns(query, e.namespace, "read-job")
         return encode(e)
+
+    def handle_alloc_stop(self, method, body, query, alloc_id):
+        """POST /v1/allocation/:id/stop (alloc_endpoint.go Stop): mark
+        the alloc for migration and evaluate its job."""
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        a = self.server.store.alloc_by_id(alloc_id)
+        if a is None:
+            # prefix match convenience, same as handle_alloc (CLI ids)
+            matches = [
+                x
+                for x in self.server.store.allocs()
+                if x.id.startswith(alloc_id)
+            ]
+            if len(matches) != 1:
+                raise APIError(404, f"alloc {alloc_id} not found")
+            a = matches[0]
+        self._enforce_obj_ns(query, a.namespace, "submit-job")
+        ev = self.server.stop_alloc(a.id)
+        if ev is None:
+            raise APIError(400, "alloc is already terminal")
+        return {"eval_id": ev.id}
 
     def handle_scheduler_config(self, method, body, query):
         cfg = self.server.store.scheduler_config()

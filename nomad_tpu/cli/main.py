@@ -471,6 +471,38 @@ def cmd_operator_debug(args) -> int:
     return 0
 
 
+def cmd_job_validate(args) -> int:
+    """`nomad job validate` (command/job_validate.go): local admission
+    validation of a jobspec file, no server round trip."""
+    from ..api.codec import decode_job
+    from ..structs.job import validate_job
+
+    payload = _load_jobfile(
+        args.file, _parse_var_flags(getattr(args, "var", None))
+    )
+    try:
+        job = decode_job(payload)
+        validate_job(job)
+    except Exception as e:  # noqa: BLE001 — validation errors surface
+        print(f"Job validation errors:\n  * {e}")
+        return 1
+    print("Job validation successful")
+    return 0
+
+
+def cmd_alloc_stop(args) -> int:
+    """`nomad alloc stop` (command/alloc_stop.go): stop + replace one
+    allocation."""
+    c = _client(args)
+    try:
+        out = c._request("POST", f"/v1/allocation/{args.alloc_id}/stop")
+    except APIException as e:
+        return _fail(str(e))
+    print(f"==> alloc {args.alloc_id[:8]} stopping "
+          f"(eval {out['eval_id'][:8]})")
+    return 0
+
+
 def cmd_job_history(args) -> int:
     """`nomad job history` (command/job_history.go)."""
     c = _client(args)
@@ -855,6 +887,10 @@ def build_parser() -> argparse.ArgumentParser:
     pforce = job.add_parser("periodic-force")
     pforce.add_argument("job_id")
     pforce.set_defaults(fn=cmd_job_periodic_force)
+    jval = job.add_parser("validate")
+    jval.add_argument("file")
+    jval.add_argument("-var", action="append", dest="var", metavar="key=value")
+    jval.set_defaults(fn=cmd_job_validate)
 
     node = sub.add_parser("node", help="node commands").add_subparsers(
         dest="sub", required=True
@@ -885,6 +921,9 @@ def build_parser() -> argparse.ArgumentParser:
     afs.add_argument("alloc_id")
     afs.add_argument("path", nargs="?", default="/")
     afs.set_defaults(fn=cmd_alloc_fs)
+    astop = alloc.add_parser("stop")
+    astop.add_argument("alloc_id")
+    astop.set_defaults(fn=cmd_alloc_stop)
     astatus = alloc.add_parser("status")
     astatus.add_argument("alloc_id")
     astatus.set_defaults(fn=cmd_alloc_status)

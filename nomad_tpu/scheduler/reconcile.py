@@ -256,6 +256,15 @@ def reconcile(
                     counts["ignore"] += 1
                 continue
 
+            if a.desired_transition.migrate:
+                # migrate mark on a HEALTHY node: `alloc stop`
+                # (alloc_endpoint.go Stop sets DesiredTransition and the
+                # reconciler replaces the alloc wherever it sits)
+                r.stop.append(StopRequest(a, REASON_ALLOC_STOPPED))
+                counts["migrate"] += 1
+                replace.append((a, a.node_id))
+                continue
+
             keep.append(a)
 
         # deployment gating context for this group

@@ -95,6 +95,14 @@ class SystemScheduler:
                 else:
                     live_by_node_group[(a.node_id, a.task_group)] = a
                 continue
+            if a.desired_transition.migrate:
+                # migrate mark on a HEALTHY node: `alloc stop` — the
+                # system reconcile stops it and (the node still being a
+                # live placement target below) replaces it in place
+                self.plan.append_stopped_alloc(
+                    a, "alloc is stopped by user"
+                )
+                continue
             live_by_node_group[(a.node_id, a.task_group)] = a
 
         stopped_job = self.job is None or self.job.stopped()

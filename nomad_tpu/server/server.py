@@ -445,6 +445,40 @@ class Server:
         )
         return self._create_node_evals(node_id)
 
+    def stop_alloc(self, alloc_id: str) -> Optional[Evaluation]:
+        """Alloc.Stop (nomad/alloc_endpoint.go): mark the allocation for
+        migration and evaluate its job — the reconciler replaces it on
+        another node. Returns the eval (None if the alloc is unknown or
+        already terminal)."""
+        from ..structs.alloc import DesiredTransition as _DT
+        from ..structs.evaluation import (
+            EVAL_STATUS_PENDING,
+            TRIGGER_ALLOC_STOP,
+        )
+
+        alloc = self.store.alloc_by_id(alloc_id)
+        if alloc is None or alloc.terminal_status():
+            return None
+        job = self.store.job_by_id(alloc.namespace, alloc.job_id)
+        ev = Evaluation(
+            namespace=alloc.namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else "service",
+            triggered_by=TRIGGER_ALLOC_STOP,
+            job_id=alloc.job_id,
+            status=EVAL_STATUS_PENDING,
+        )
+        self.raft_apply(
+            self._msg.ALLOC_DESIRED_TRANSITION,
+            {
+                "transitions": {alloc_id: _DT(migrate=True)},
+                "evals": [ev],
+            },
+        )
+        (ev,) = self._fresh_evals([ev])
+        self.eval_broker.enqueue(ev)
+        return ev
+
     def _create_node_evals(self, node_id: str) -> list[Evaluation]:
         jobs = {}
         for a in self.store.allocs_by_node(node_id):

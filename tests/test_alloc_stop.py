@@ -1,0 +1,76 @@
+"""`alloc stop` (alloc_endpoint.go Stop + command/alloc_stop.go): the
+migrate mark on a healthy node stops and replaces the allocation, end to
+end through the HTTP API and CLI."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import DevAgent
+from nomad_tpu.api.http import HTTPAgent
+from nomad_tpu.cli.main import main
+
+
+@pytest.fixture()
+def harness(tmp_path):
+    agent = DevAgent(data_dir=str(tmp_path), num_workers=1)
+    agent.start()
+    http = HTTPAgent(agent.server, agent.client, port=0)
+    http.start()
+    yield agent, http
+    http.stop()
+    agent.shutdown()
+
+
+def wait_until(cond, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_alloc_stop_replaces(harness, capsys):
+    agent, http = harness
+    job = mock.job()
+    job.id = "stoppable"
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].driver = "mock_driver"
+    tg.tasks[0].config = {"run_for": 600}
+    tg.tasks[0].resources.cpu = 50
+    tg.tasks[0].resources.memory_mb = 32
+    agent.register_job(job)
+
+    def running():
+        allocs = [
+            a
+            for a in agent.store.allocs_by_job("default", "stoppable")
+            if not a.terminal_status()
+        ]
+        return allocs if allocs and allocs[0].client_status == "running" else None
+
+    assert wait_until(lambda: running() is not None)
+    old = running()[0]
+    addr = ["--address", http.address]
+    assert main(addr + ["alloc", "stop", old.id]) == 0
+    assert "stopping" in capsys.readouterr().out
+
+    def replaced():
+        cur = agent.store.allocs_by_job("default", "stoppable")
+        fresh = [
+            a for a in cur if a.id != old.id and not a.terminal_status()
+        ]
+        old_now = next((a for a in cur if a.id == old.id), None)
+        return bool(fresh) and (
+            old_now is None or old_now.desired_status != "run"
+        )
+
+    assert wait_until(replaced), "stopped alloc was not replaced"
+
+
+def test_stop_terminal_alloc_rejected(harness):
+    agent, http = harness
+    assert agent.server.stop_alloc("nonexistent") is None
