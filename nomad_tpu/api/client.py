@@ -295,6 +295,11 @@ class Deployments:
     def promote(self, deployment_id: str):
         return self.c.post(f"/v1/deployment/promote/{deployment_id}")
 
+    def pause(self, deployment_id: str, pause: bool = True):
+        return self.c.post(
+            f"/v1/deployment/pause/{deployment_id}", {"pause": pause}
+        )
+
     def fail(self, deployment_id: str):
         return self.c.post(f"/v1/deployment/fail/{deployment_id}")
 

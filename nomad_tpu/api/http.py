@@ -96,6 +96,10 @@ class HTTPAgent:
                 self.handle_deployment_fail,
             ),
             (
+                re.compile(r"^/v1/deployment/pause/(?P<deployment_id>[^/]+)$"),
+                self.handle_deployment_pause,
+            ),
+            (
                 re.compile(r"^/v1/deployment/(?P<deployment_id>[^/]+)$"),
                 self.handle_deployment,
             ),
@@ -543,6 +547,19 @@ class HTTPAgent:
         if not ok:
             raise APIError(400, "deployment is not active")
         return {"promoted": True}
+
+    def handle_deployment_pause(self, method, body, query, deployment_id):
+        """POST /v1/deployment/pause/:id {"pause": bool}
+        (deployment_endpoint.go Pause)."""
+        if method not in ("POST", "PUT"):
+            raise APIError(405, "POST required")
+        d = self._get_deployment(deployment_id)
+        self._enforce_obj_ns(query, d.namespace, "submit-job")
+        pause = bool((body or {}).get("pause", True))
+        ok = self.server.deployment_watcher.pause(d.id, pause)
+        if not ok:
+            raise APIError(400, "deployment is not active")
+        return {"paused": pause}
 
     def handle_deployment_fail(self, method, body, query, deployment_id):
         if method not in ("POST", "PUT"):

@@ -456,6 +456,19 @@ def cmd_deployment_fail(args) -> int:
     return 0
 
 
+def cmd_deployment_pause(args) -> int:
+    """`nomad deployment pause|resume` (command/deployment_pause.go,
+    deployment_resume.go)."""
+    c = _client(args)
+    pause = not getattr(args, "resume", False)
+    try:
+        c.deployments.pause(args.deployment_id, pause)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"==> deployment {'paused' if pause else 'resumed'}")
+    return 0
+
+
 def cmd_operator_debug(args) -> int:
     """`nomad operator debug` (command/operator_debug.go:54): capture a
     support bundle (metrics, broker/worker/raft stats, thread dump) to a
@@ -951,6 +964,12 @@ def build_parser() -> argparse.ArgumentParser:
     dfail = dep.add_parser("fail")
     dfail.add_argument("deployment_id")
     dfail.set_defaults(fn=cmd_deployment_fail)
+    dpause = dep.add_parser("pause")
+    dpause.add_argument("deployment_id")
+    dpause.set_defaults(fn=cmd_deployment_pause, resume=False)
+    dresume = dep.add_parser("resume")
+    dresume.add_argument("deployment_id")
+    dresume.set_defaults(fn=cmd_deployment_pause, resume=True)
 
     vol = sub.add_parser("volume", help="volume commands").add_subparsers(
         dest="sub", required=True

@@ -276,13 +276,15 @@ def reconcile(
             and deployment.job_version == job.version
             else None
         )
-        # a FAILED deployment for this very version halts the rollout:
+        # a FAILED deployment for this very version halts the rollout —
         # no further replacements, no fresh deployment — until a new job
-        # version (e.g. auto-revert) arrives (deploymentwatcher semantics)
+        # version (e.g. auto-revert) arrives; a PAUSED one freezes it the
+        # same way until the operator resumes (deployment_endpoint.go
+        # Pause: an eval arriving mid-pause must not advance the rollout)
         rollout_halted = (
             deployment is not None
             and deployment.job_version == job.version
-            and deployment.status == "failed"
+            and deployment.status in ("failed", "paused")
         )
         # unpromoted canaries run *beside* the old version: they don't
         # count toward desired and must not trigger surplus stops

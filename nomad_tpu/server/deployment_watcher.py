@@ -23,6 +23,7 @@ from typing import Optional
 from ..structs import Evaluation
 from ..structs.deployment import (
     DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_PAUSED,
     DEPLOYMENT_STATUS_RUNNING,
     DEPLOYMENT_STATUS_SUCCESSFUL,
     DESC_AUTO_REVERT,
@@ -68,6 +69,11 @@ class DeploymentWatcher:
         store = self.server.store
         for d in list(store.deployments()):
             if not d.active():
+                continue
+            if d.status == DEPLOYMENT_STATUS_PAUSED:
+                # paused (deployment_endpoint.go Pause): health verdicts,
+                # auto-promotion, and the progress clock all freeze until
+                # the operator resumes
                 continue
             job = store.job_by_id(d.namespace, d.job_id)
             allocs = [
@@ -235,6 +241,52 @@ class DeploymentWatcher:
         job = store.job_by_id(d.namespace, d.job_id)
         if job is not None:
             self._create_eval(job)
+        return True
+
+    def pause(self, deployment_id: str, pause: bool = True) -> bool:
+        """DeploymentPauseRequest: freeze/resume the rollout. Pausing
+        also pushes out each group's progress deadline by the paused
+        interval's worth on resume (the clock must not have been running
+        while frozen)."""
+        d = self.server.store.deployment_by_id(deployment_id)
+        if d is None or not d.active():
+            return False
+        target = (
+            DEPLOYMENT_STATUS_PAUSED if pause else DEPLOYMENT_STATUS_RUNNING
+        )
+        if d.status == target:
+            return True
+        if not pause:
+            # resume: restart each group's progress window from now
+            d2 = copy.deepcopy(d)
+            d2.status = target
+            d2.status_description = "Deployment is running"
+            now = time.time()
+            for s in d2.task_groups.values():
+                if s.progress_deadline_s:
+                    s.require_progress_by_unix = now + s.progress_deadline_s
+            self.server.raft_apply(
+                MsgType.DEPLOYMENT_UPSERT, {"deployment": d2}
+            )
+            # the per-alloc health clocks must not have run while frozen:
+            # clearing them re-seeds min_healthy_time AND the checked-
+            # group healthy_deadline backstop from the resume instant
+            # (otherwise a pause longer than the deadline fails every
+            # checked alloc on the first post-resume tick)
+            for a in self.server.store.allocs_by_job(
+                d.namespace, d.job_id
+            ):
+                if a.deployment_id == d.id:
+                    self._running_since.pop(a.id, None)
+        else:
+            self.server.raft_apply(
+                MsgType.DEPLOYMENT_STATUS,
+                {
+                    "deployment_id": d.id,
+                    "status": target,
+                    "description": "Deployment is paused",
+                },
+            )
         return True
 
     def fail(self, deployment_id: str) -> bool:

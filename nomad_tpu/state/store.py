@@ -675,6 +675,15 @@ class StateStore(StateSnapshot):
         with self._lock:
             table = self._own("deployments")
             existing = table.get(deployment.id)
+            if (
+                existing is not None
+                and not existing.active()
+                and deployment.active()
+            ):
+                # same-id upsert flipping a TERMINAL deployment back to
+                # active can only be a racing pause/resume (new rollouts
+                # mint new ids) — refuse the resurrection
+                return
             deployment.create_index = existing.create_index if existing else index
             deployment.modify_index = index
             table[deployment.id] = deployment
@@ -860,6 +869,12 @@ class StateStore(StateSnapshot):
         d = table.get(deployment_id)
         if d is None:
             return
+        if not d.active() and status in ("paused", "running"):
+            # a pause/resume that raced a terminal transition must not
+            # resurrect the deployment (deployment_endpoint.go rejects
+            # state changes on terminal deployments; the applier-side
+            # guard makes the race benign for every submitter)
+            return
         d2 = _copy.deepcopy(d)
         d2.status = status
         d2.status_description = desc
@@ -877,6 +892,18 @@ class StateStore(StateSnapshot):
         """Replace a deployment record (watcher count refresh)."""
         with self._lock:
             table = self._own("deployments")
+            existing = table.get(deployment.id)
+            if (
+                existing is not None
+                and not existing.active()
+                and deployment.active()
+            ):
+                # a replace flipping a TERMINAL deployment back to active
+                # can only be a racing pause/resume or a stale watcher
+                # refresh — refuse the resurrection (the endpoint-side
+                # active() check is advisory; this guard is authoritative)
+                self._bump(index, "deployments")
+                return
             deployment.modify_index = index
             table[deployment.id] = deployment
             self._bump(index, "deployments")

@@ -190,3 +190,88 @@ class TestAutoRevert:
             if d.job_id == job.id and d.status == "failed"
         ]
         assert failed
+
+
+class TestPauseResume:
+    def test_pause_freezes_and_resume_restarts(self, agent):
+        """deployment pause: the watcher tick and the reconciler both
+        freeze the rollout; resume restarts it and re-seeds the health
+        clocks (deployment_endpoint.go Pause/Resume semantics)."""
+        import copy as _copy
+
+        job = service_job(count=2, auto_revert=False)
+        agent.register_job(job)
+        assert wait_until(lambda: len(live(agent, job)) == 2)
+        j2 = _copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].config = {"run_for": 601}
+        j2.task_groups[0].update.min_healthy_time_s = 0.1
+        agent.register_job(j2)
+        assert wait_until(
+            lambda: active_deployment(agent, job) is not None
+        )
+        d = active_deployment(agent, job)
+        assert agent.server.deployment_watcher.pause(d.id, True)
+        assert wait_until(
+            lambda: agent.store.deployment_by_id(d.id).status == "paused"
+        )
+        # FROZEN: with min_healthy_time 0.1s the deployment would
+        # complete in well under a second if the watcher were running —
+        # paused, its health counts and status must not move
+        before = agent.store.deployment_by_id(d.id)
+        h_before = sum(
+            s.healthy_allocs for s in before.task_groups.values()
+        )
+        time.sleep(1.0)
+        agent.server.deployment_watcher.tick()  # explicit tick: still frozen
+        after = agent.store.deployment_by_id(d.id)
+        assert after.status == "paused"
+        assert (
+            sum(s.healthy_allocs for s in after.task_groups.values())
+            == h_before
+        )
+        # resume: the rollout completes
+        assert agent.server.deployment_watcher.pause(d.id, False)
+        assert wait_until(
+            lambda: agent.store.deployment_by_id(d.id).status
+            == "successful",
+            timeout=30,
+        )
+
+    def test_pause_inactive_rejected(self, agent):
+        assert not agent.server.deployment_watcher.pause("nope", True)
+
+    def test_pause_does_not_resurrect_terminal(self, agent):
+        """A pause/resume racing a terminal transition must not flip the
+        deployment back to active (store-level guard)."""
+        import copy as _copy
+
+        job = service_job(count=1)
+        agent.register_job(job)
+        assert wait_until(lambda: len(live(agent, job)) == 1)
+        j2 = _copy.deepcopy(job)
+        j2.task_groups[0].tasks[0].config = {"run_for": 601}
+        agent.register_job(j2)
+        assert wait_until(
+            lambda: active_deployment(agent, job) is not None
+        )
+        d = active_deployment(agent, job)
+        assert wait_until(
+            lambda: agent.store.deployment_by_id(d.id).status
+            == "successful",
+            timeout=30,
+        )
+        # racing pause/resume submitted after the terminal transition
+        from nomad_tpu.server.fsm import MsgType
+
+        agent.server.raft_apply(
+            MsgType.DEPLOYMENT_STATUS,
+            {"deployment_id": d.id, "status": "paused",
+             "description": "racing pause"},
+        )
+        assert agent.store.deployment_by_id(d.id).status == "successful"
+        stale = _copy.deepcopy(agent.store.deployment_by_id(d.id))
+        stale.status = "running"
+        agent.server.raft_apply(
+            MsgType.DEPLOYMENT_UPSERT, {"deployment": stale}
+        )
+        assert agent.store.deployment_by_id(d.id).status == "successful"
