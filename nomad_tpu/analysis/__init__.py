@@ -1,0 +1,29 @@
+"""nomad_tpu.analysis — repo-specific static analysis & runtime checkers.
+
+Three engines behind one CLI (``python -m nomad_tpu.analysis``) and one
+fast pytest entry point (tests/test_static_analysis.py):
+
+- ``lint``    — an AST visitor framework plus repo-specific rules
+  (NTA001–NTA005) that encode the invariants the north star depends on
+  but the test suite cannot see: trace-pure device kernels, deterministic
+  scheduler scoring, observable exception handling, frozen plans after
+  submission, and class-level lock discipline.
+- ``race``    — an env-gated (``NOMAD_TPU_RACECHECK=1``) instrumented
+  ``threading.Lock``/``RLock`` wrapper that records per-thread lock
+  acquisition order, builds the global lock graph, and reports cycles
+  (deadlock potential) and guarded-field accesses without the owning
+  lock.
+- ``retrace`` — a jit-retrace budget checker over the trace counters the
+  ``utils.backend.traced_jit`` wrapper maintains for the hot-path device
+  kernels; a kernel that silently retraces past its declared budget
+  across a bench batch fails the check.
+
+Lint findings diff against the checked-in ``analysis/baseline.json``:
+pre-existing violations are ratcheted (they stay visible and must not
+grow), new ones fail the run. ``--fix-baseline`` regenerates the file
+deterministically (sorted, path-relative).
+"""
+
+from . import lint, race, retrace  # noqa: F401
+
+__all__ = ["lint", "race", "retrace"]

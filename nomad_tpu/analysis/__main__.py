@@ -1,0 +1,114 @@
+"""CLI: ``python -m nomad_tpu.analysis``.
+
+Default action: lint the repo, diff against the checked-in baseline,
+exit 1 on any NEW finding (pre-existing baselined findings are reported
+as ratcheted, not blocking). ``--fix-baseline`` regenerates the baseline
+deterministically (sorted entries, path-relative, line-number-free
+fingerprints) — run it after fixing violations so the ratchet tightens.
+
+    python -m nomad_tpu.analysis                  # lint vs baseline
+    python -m nomad_tpu.analysis --json           # machine-readable
+    python -m nomad_tpu.analysis --rules NTA003   # subset of rules
+    python -m nomad_tpu.analysis --fix-baseline   # regenerate baseline
+    python -m nomad_tpu.analysis --retrace-report # jit budget registry
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import lint
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m nomad_tpu.analysis",
+        description="repo-specific static analysis (NTA001-NTA005)",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="specific .py files to lint (default: whole nomad_tpu tree)",
+    )
+    p.add_argument(
+        "--root", type=Path, default=None,
+        help="repo root (default: the tree containing this package)",
+    )
+    p.add_argument(
+        "--baseline", type=Path, default=None,
+        help="baseline file (default: nomad_tpu/analysis/baseline.json)",
+    )
+    p.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--fix-baseline", action="store_true",
+        help="regenerate the baseline from current findings and exit 0",
+    )
+    p.add_argument("--json", action="store_true", help="JSON output")
+    p.add_argument(
+        "--retrace-report", action="store_true",
+        help="print the jit trace-count/budget registry and exit "
+        "(imports the device kernels)",
+    )
+    args = p.parse_args(argv)
+
+    if args.retrace_report:
+        from . import retrace
+        from ..device import preempt, score  # noqa: F401 — registers kernels
+
+        print(json.dumps(retrace.report(), indent=2))
+        return 0
+
+    root = (args.root or lint.repo_root()).resolve()
+    rules = None
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        rules = [r for r in lint.all_rules() if r.id in wanted]
+        missing = wanted - {r.id for r in rules}
+        if missing:
+            print(f"unknown rules: {', '.join(sorted(missing))}",
+                  file=sys.stderr)
+            return 2
+
+    findings = lint.run_lint(root, paths=args.paths or None, rules=rules)
+
+    baseline_path = args.baseline or lint.default_baseline_path()
+    if args.fix_baseline:
+        lint.write_baseline(findings, baseline_path)
+        print(
+            f"baseline regenerated: {len(findings)} finding(s) -> "
+            f"{baseline_path}"
+        )
+        return 0
+
+    baseline = lint.load_baseline(baseline_path)
+    new, fixed = lint.diff_against_baseline(findings, baseline)
+    ratcheted = len(findings) - len(new)
+
+    if args.json:
+        print(json.dumps({
+            "new": [f.__dict__ | {"fingerprint": f.fingerprint} for f in new],
+            "ratcheted": ratcheted,
+            "fixed": sorted(fixed),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if fixed:
+            print(
+                f"note: {len(fixed)} baselined finding(s) no longer fire — "
+                f"run --fix-baseline to tighten the ratchet"
+            )
+        print(
+            f"{len(new)} new finding(s), {ratcheted} ratcheted "
+            f"(baselined), {len(fixed)} fixed"
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

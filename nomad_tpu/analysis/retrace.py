@@ -1,0 +1,94 @@
+"""jit-retrace budget checker.
+
+The placement kernels bucket their dynamic dimensions (node count, victim
+count, scan steps) to powers of two precisely so a 10k-node bench batch
+costs a handful of XLA compiles, not hundreds. That property regresses
+silently: drop one ``static_argnames`` entry or un-bucket one dimension
+and every call traces afresh — the suite still passes, the bench just
+gets 100× slower. This checker turns the property into an assertion.
+
+Mechanism: the kernels in ``device/score.py`` / ``device/preempt.py`` are
+wrapped by ``utils.backend.traced_jit``, which counts one tick per actual
+XLA trace and registers each kernel's declared budget. ``budget_window()``
+scopes the check: run a representative batch inside the window, and any
+tracked kernel whose trace count *within the window* exceeds its budget
+raises with the offending counts.
+
+    with retrace.budget_window():
+        for _ in range(64):
+            kernel.place(ct, asks)     # same shapes -> 1 trace, not 64
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from ..utils import backend
+
+
+class RetraceBudgetExceeded(AssertionError):
+    def __init__(self, offenders: list[tuple[str, int, int]]):
+        self.offenders = offenders
+        super().__init__(
+            "; ".join(
+                f"{name}: {count} traces > budget {budget}"
+                for name, count, budget in offenders
+            )
+        )
+
+
+def counts() -> dict[str, int]:
+    """Cumulative trace counts per tracked callable (process lifetime)."""
+    return backend.trace_counts()
+
+
+def budgets() -> dict[str, int]:
+    return backend.trace_budgets()
+
+
+def over_budget(
+    window_counts: dict[str, int] | None = None,
+) -> list[tuple[str, int, int]]:
+    """(name, traces, budget) for every tracked callable past its budget.
+    With no argument, checks cumulative process-lifetime counts."""
+    current = window_counts if window_counts is not None else counts()
+    budget_map = budgets()
+    out = [
+        (name, current.get(name, 0), budget)
+        for name, budget in sorted(budget_map.items())
+        if current.get(name, 0) > budget
+    ]
+    return out
+
+
+def check(window_counts: dict[str, int] | None = None) -> None:
+    offenders = over_budget(window_counts)
+    if offenders:
+        raise RetraceBudgetExceeded(offenders)
+
+
+@contextmanager
+def budget_window():
+    """Scope a budget check to the workload inside the ``with`` block:
+    deltas (not cumulative counts) are compared against each declared
+    budget, so earlier compiles in the process don't count against it."""
+    before = counts()
+    yield
+    after = counts()
+    deltas = {
+        name: after.get(name, 0) - before.get(name, 0) for name in after
+    }
+    check(deltas)
+
+
+def report() -> dict:
+    """CLI/report payload: per-kernel counts vs budgets."""
+    current = counts()
+    budget_map = budgets()
+    return {
+        name: {
+            "traces": current.get(name, 0),
+            "budget": budget_map.get(name),
+        }
+        for name in sorted(set(current) | set(budget_map))
+    }

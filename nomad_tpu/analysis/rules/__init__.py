@@ -1,0 +1,19 @@
+"""Rule registry. Each rule module registers its Rule subclass here; the
+engine instantiates every registered rule per run. Order is the report
+order for same-line findings, so keep it sorted by rule id."""
+
+from .determinism import WallClockInScoringPath  # noqa: E402
+from .hostsync import HostSyncInJitKernel  # noqa: E402
+from .swallow import SilentExceptionSwallow  # noqa: E402
+from .planfreeze import PlanMutationAfterSubmit  # noqa: E402
+from .lockfields import LockDiscipline  # noqa: E402
+
+REGISTRY = [
+    WallClockInScoringPath,  # NTA001
+    HostSyncInJitKernel,  # NTA002
+    SilentExceptionSwallow,  # NTA003
+    PlanMutationAfterSubmit,  # NTA004
+    LockDiscipline,  # NTA005
+]
+
+__all__ = ["REGISTRY"]

@@ -1,0 +1,139 @@
+"""NTA002 — no host syncs inside jit-compiled device kernels.
+
+A ``.item()``, a Python ``float()``/``int()`` on a traced value, an
+``np.*`` call, or a Python loop over node arrays inside a jitted kernel
+either fails tracing outright or — worse — silently forces a device→host
+round trip per step and turns the one-pass placement kernel back into the
+reference's sequential walk. The batch kernels must stay trace-pure.
+
+Scope: ``nomad_tpu/device/score.py`` and ``nomad_tpu/device/preempt.py``.
+A function counts as jitted when decorated with ``jax.jit``,
+``functools.partial(jax.jit, ...)``, or the trace-counting wrapper
+``traced_jit`` / ``backend.traced_jit`` (same forms). Everything lexically
+inside a jitted function — including nested defs handed to ``lax.scan`` /
+``vmap`` — is traced, so the whole subtree is checked.
+
+``for x in range(...)`` is allowed: static-bound unrolling is the idiom
+the chunked kernels rely on. Any other ``for``/``while`` is flagged —
+data-dependent loops belong in ``lax.scan`` / ``fori_loop``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, Rule, ScopedVisitor, dotted_name
+
+_JIT_NAMES = {
+    "jax.jit",
+    "jit",
+    "traced_jit",
+    "backend.traced_jit",
+    "utils.backend.traced_jit",
+}
+
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    name = dotted_name(dec)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _JIT_NAMES:
+            return True  # jax.jit(...) / traced_jit(...) with options
+        if fname in ("functools.partial", "partial"):
+            return bool(dec.args) and dotted_name(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+def _is_range_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "range"
+    )
+
+
+class _KernelVisitor(ScopedVisitor):
+    """Walks the body of one jitted function (scope stack pre-seeded)."""
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            self.add("NTA002", node, "host sync: .item() inside a jitted kernel")
+        name = dotted_name(node.func)
+        if name:
+            if name.split(".")[0] in ("np", "numpy", "onp"):
+                self.add(
+                    "NTA002",
+                    node,
+                    f"host round trip: {name}() inside a jitted kernel "
+                    f"(use jnp/lax)",
+                )
+            elif (
+                name in _CAST_BUILTINS
+                and len(node.args) == 1
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                self.add(
+                    "NTA002",
+                    node,
+                    f"host sync: {name}() on a traced value inside a "
+                    f"jitted kernel",
+                )
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if not _is_range_call(node.iter):
+            self.add(
+                "NTA002",
+                node,
+                "Python for-loop over traced values inside a jitted kernel "
+                "(use lax.scan/fori_loop or a static range)",
+            )
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self.add(
+            "NTA002",
+            node,
+            "Python while-loop inside a jitted kernel "
+            "(use lax.while_loop)",
+        )
+        self.generic_visit(node)
+
+
+class _Finder(ScopedVisitor):
+    """Finds jitted top-level or nested functions and hands their bodies
+    to the kernel visitor."""
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if any(_is_jit_decorator(d) for d in node.decorator_list):
+            kv = _KernelVisitor(self.relpath)
+            kv._scope = self._scope + [node.name]
+            for stmt in node.body:
+                kv.visit(stmt)
+            self.findings.extend(kv.findings)
+        else:
+            self._push(node.name, node)
+
+
+class HostSyncInJitKernel(Rule):
+    id = "NTA002"
+    title = "no host syncs inside jit-compiled device kernels"
+
+    def applies_to(self, relpath: str) -> bool:
+        return relpath in (
+            "nomad_tpu/device/score.py",
+            "nomad_tpu/device/preempt.py",
+        )
+
+    def check(self, tree, source, relpath) -> list[Finding]:
+        v = _Finder(relpath)
+        v.visit(tree)
+        return v.findings

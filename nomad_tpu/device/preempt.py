@@ -29,9 +29,13 @@ the single-resource-direction sense the greedy covers.
 
 from __future__ import annotations
 
-import jax
+import functools
+
+import jax  # noqa: F401 — kernels trace through traced_jit
 import jax.numpy as jnp
 import numpy as np
+
+from ..utils.backend import traced_jit
 
 # Priority delta a preemptor must have over its victims
 # (preemption.go:673: delta ≥ 10).
@@ -53,7 +57,7 @@ def resource_distance(ask, victim):
     return jnp.sqrt(jnp.sum(rel * rel, axis=-1))
 
 
-@jax.jit
+@functools.partial(traced_jit, retrace_budget=8)
 def find_preemption_kernel(
     capacity,  # f32[N, D]
     used,  # f32[N, D] (incl. victims)
@@ -108,7 +112,7 @@ def find_preemption_kernel(
     return any_fit, k.astype(jnp.int32), net, order.astype(jnp.int32)
 
 
-@jax.jit
+@functools.partial(traced_jit, retrace_budget=8)
 def choose_preemption_node_kernel(
     capacity,
     used,

@@ -62,6 +62,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..structs.resources import BINPACK_MAX_SCORE
+from ..utils.backend import traced_jit
+
+# Retrace budgets (nomad_tpu.analysis.retrace): the per-kernel trace
+# count a representative bench batch may reach. Every dynamic dimension
+# is bucketed (nodes/victims/steps to powers of two, k to the overflow
+# grid), so distinct static-arg combos — not calls — bound compiles; a
+# kernel that blows its budget has lost a shape bucket or a static arg.
+RETRACE_BUDGET = 16
 
 _LN10 = 2.302585092994046
 
@@ -257,7 +265,8 @@ def _score_planes(
 # (scheduler/rank.go:193-527): O(N·J) parallel work, O(log) depth.
 
 
-@functools.partial(jax.jit, static_argnames=("max_j", "k"))
+@functools.partial(traced_jit, retrace_budget=RETRACE_BUDGET,
+                   static_argnames=("max_j", "k"))
 def place_closed_form_kernel(
     capacity,  # f32[N, D] shared
     used0,  # f32[N, D] shared snapshot usage
@@ -370,7 +379,8 @@ def _block_tables(c, desired, caps, weights, kinds):
     return boost, allow
 
 
-@functools.partial(jax.jit, static_argnames=("max_j", "max_steps"))
+@functools.partial(traced_jit, retrace_budget=RETRACE_BUDGET,
+                   static_argnames=("max_j", "max_steps"))
 def place_value_scan_kernel(
     capacity,  # f32[N, D] shared
     used0,  # f32[N, D] shared snapshot usage
@@ -484,7 +494,8 @@ def place_value_scan_kernel(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("max_j", "chunk", "n_chunks"))
+@functools.partial(traced_jit, retrace_budget=RETRACE_BUDGET,
+                   static_argnames=("max_j", "chunk", "n_chunks"))
 def place_spread_chunked_kernel(
     capacity,  # f32[N, D] shared
     used0,  # f32[N, D] shared snapshot usage
@@ -627,7 +638,8 @@ def place_spread_chunked_kernel(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("max_j", "k_seg", "n_chunks"))
+@functools.partial(traced_jit, retrace_budget=RETRACE_BUDGET,
+                   static_argnames=("max_j", "k_seg", "n_chunks"))
 def place_spread_opv_kernel(
     capacity,  # f32[N, D] shared
     used0,  # f32[N, D] shared snapshot usage
@@ -851,7 +863,7 @@ def place_spread_opv_kernel(
     )
 
 
-@jax.jit
+@functools.partial(traced_jit, retrace_budget=RETRACE_BUDGET)
 def score_matrix_kernel(
     capacity,
     used,

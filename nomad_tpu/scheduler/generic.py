@@ -82,10 +82,16 @@ class GenericScheduler:
         batch: bool = False,
         cache=None,
         overlay=None,
+        clock=None,
     ):
         self.snapshot = snapshot
         self.planner = planner
         self.batch = batch
+        # injectable clock: every wall-time the scheduler stamps into a
+        # plan (deployment deadlines, followup-eval times, reschedule
+        # events) reads this, so replaying an eval stream against a fixed
+        # clock reproduces byte-identical plans (NTA001 enforces it)
+        self.clock = clock if clock is not None else time.time
         # resident device-state cache — per-server in production (the
         # worker threads share it); a private one here keeps standalone
         # scheduler construction working
@@ -258,6 +264,7 @@ class GenericScheduler:
             existing,
             tainted,
             batch=self.batch,
+            now_ns=int(self.clock() * 1e9),
             deployment=deployment,
         )
 
@@ -281,7 +288,7 @@ class GenericScheduler:
         if results.deployment_states and self.job is not None:
             from ..structs.deployment import Deployment
 
-            now = time.time()
+            now = self.clock()
             for s in results.deployment_states.values():
                 s.require_progress_by_unix = now + s.progress_deadline_s
             new_d = Deployment(
@@ -317,7 +324,7 @@ class GenericScheduler:
         # delayed reschedules become followup evals (generic_sched.go:718-753);
         # the failed alloc is updated in-plan with followup_eval_id so later
         # reconciles don't spawn duplicates (reconcile.py checks it)
-        now = time.time()
+        now = self.clock()
         by_delay: dict[float, Evaluation] = {}
         for alloc, delay in results.disconnect_followups:
             f = by_delay.get(delay)
@@ -520,7 +527,7 @@ class GenericScheduler:
                         )
                         events.append(
                             RescheduleEvent(
-                                reschedule_time_ns=time.time_ns(),
+                                reschedule_time_ns=int(self.clock() * 1e9),
                                 prev_alloc_id=prev.id,
                                 prev_node_id=prev.node_id,
                             )

@@ -164,7 +164,10 @@ def reconcile(
     (reconcile.go's deployment-aware computeGroup logic).
     """
     r = ReconcileResults()
-    now_ns = now_ns if now_ns is not None else time.time_ns()
+    # injection fallback only: schedulers pass now_ns from their context
+    # clock so replays are deterministic
+    if now_ns is None:
+        now_ns = time.time_ns()  # nta: allow=NTA001
     stopped = job is None or job.stopped()
 
     live = [a for a in existing if not a.terminal_status()]

@@ -29,6 +29,7 @@ import threading
 from typing import Dict, Optional
 
 from ..raft import NotLeaderError, RaftNode
+from ..utils.metrics import global_metrics as metrics
 from ..raft.node import RaftConfig
 from ..rpc import RPCClient, RPCServer
 from ..state.snapshot import restore_snapshot, save_snapshot
@@ -188,6 +189,7 @@ class ClusterServer:
                 log.info("autopilot: removed dead server %s", pid)
             except Exception:
                 log.exception("autopilot: remove_peer %s failed", pid)
+                metrics.incr("cluster.swallowed_errors")
         return removed
 
     def _autopilot_loop(self) -> None:
@@ -196,6 +198,7 @@ class ClusterServer:
                 self.autopilot_sweep()
             except Exception:
                 log.exception("autopilot sweep failed")
+                metrics.incr("cluster.swallowed_errors")
 
     # -- leadership hooks (leader.go monitorLeadership) --------------------
     def _on_leader(self) -> None:

@@ -32,6 +32,7 @@ import numpy as np
 
 from ..scheduler import new_scheduler
 from ..structs import Evaluation, Plan
+from ..utils.metrics import count_swallowed
 from ..utils.metrics import global_metrics as metrics
 
 log = logging.getLogger("nomad_tpu.worker")
@@ -190,12 +191,13 @@ class Worker:
                 # would wedge those jobs (the broker has no redelivery
                 # deadline). Nack everything still outstanding.
                 log.exception("worker %d: batch failed", self.id)
+                metrics.incr("worker.swallowed_errors")
                 for ev, token in batch:
                     try:
                         self.server.eval_broker.nack(ev.id, token)
                         self._bump("nacked")
-                    except ValueError:
-                        pass  # already acked/nacked
+                    except ValueError as e:
+                        count_swallowed("worker", e)  # already acked/nacked
         self._join_commit()
 
     def _run_one(self, ev: Evaluation, token: str) -> None:
@@ -206,10 +208,11 @@ class Worker:
             self._bump("acked")
         except Exception:
             log.exception("worker %d: eval %s failed", self.id, ev.id)
+            metrics.incr("worker.swallowed_errors")
             try:
                 self.server.eval_broker.nack(ev.id, token)
-            except ValueError:
-                pass
+            except ValueError as e:
+                count_swallowed("worker", e)
             self._bump("nacked", "processed")
         # per-eval counter: the invoke_scheduler TIMER emits one sample per
         # batched pass, so throughput accounting reads this counter instead
@@ -263,6 +266,7 @@ class Worker:
                 asks = sched.prepare_batch_attempt(ev, ct=ct)
             except Exception:
                 log.exception("worker %d: batch prepare %s", self.id, ev.id)
+                metrics.incr("worker.swallowed_errors")
                 asks = None
                 singles.append((ev, token))
                 continue
@@ -321,6 +325,7 @@ class Worker:
                 # shared pass failed — every prepared eval falls back to
                 # the individual path rather than dying unacked
                 log.exception("worker %d: combined kernel pass", self.id)
+                metrics.incr("worker.swallowed_errors")
                 metrics.incr("nomad.worker.batch_kernel_errors")
                 singles.extend((ev, token) for ev, token, _, _ in prepared)
                 prepared = []
@@ -413,10 +418,11 @@ class Worker:
                     log.exception(
                         "worker %d: batch complete %s", self.id, ev.id
                     )
+                    metrics.incr("worker.swallowed_errors")
                     try:
                         self.server.eval_broker.nack(ev.id, token)
-                    except ValueError:
-                        pass
+                    except ValueError as e:
+                        count_swallowed("worker", e)
                     self._bump("nacked", "processed")
                     metrics.incr("nomad.worker.evals_processed")
 
@@ -427,14 +433,15 @@ class Worker:
             # the commit thread must never die with evals unacked —
             # including the singles that accumulated from fallbacks
             log.exception("worker %d: commit thread failed", self.id)
+            metrics.incr("worker.swallowed_errors")
             outstanding = [
                 (ev, token) for ev, token, _s, _n in prepared
             ] + list(singles)
             for ev, token in outstanding:
                 try:
                     self.server.eval_broker.nack(ev.id, token)
-                except Exception:  # noqa: BLE001 — best-effort cleanup
-                    pass
+                except Exception as e:  # best-effort cleanup
+                    count_swallowed("worker", e)
 
     def process_eval(self, ev: Evaluation, planner=None) -> None:
         # raft catch-up barrier (worker.go:536-549)

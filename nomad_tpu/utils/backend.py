@@ -8,8 +8,79 @@ right env. Both bench.py and __graft_entry__.dryrun_multichip share this
 hazard; this module is the single copy of the workaround.
 """
 
+import functools
 import os
 import threading
+
+# -- jit trace accounting ----------------------------------------------------
+#
+# ``traced_jit`` is the seam the retrace budget checker
+# (nomad_tpu.analysis.retrace) reads: it wraps a kernel's Python body with
+# a counter bump BEFORE handing it to jax.jit, so the counter increments
+# exactly once per XLA trace (jit only re-executes the Python body on a
+# cache miss) and never on a cached dispatch. A hot-path kernel that
+# silently retraces per call — a dropped shape bucket, a static arg that
+# became dynamic — shows up as a counter marching in lockstep with the
+# call count instead of plateauing at the handful of shape buckets its
+# declared budget allows.
+
+_trace_lock = threading.Lock()
+_trace_counts: dict[str, int] = {}
+_trace_budgets: dict[str, int] = {}
+
+
+def record_trace(name: str) -> None:
+    with _trace_lock:
+        _trace_counts[name] = _trace_counts.get(name, 0) + 1
+
+
+def trace_counts() -> dict[str, int]:
+    with _trace_lock:
+        return dict(_trace_counts)
+
+
+def trace_budgets() -> dict[str, int]:
+    with _trace_lock:
+        return dict(_trace_budgets)
+
+
+def reset_trace_counts() -> None:
+    with _trace_lock:
+        for k in _trace_counts:
+            _trace_counts[k] = 0
+
+
+def traced_jit(fn=None, *, trace_name=None, retrace_budget=None, **jit_kwargs):
+    """Drop-in ``jax.jit`` replacement that counts traces per callable and
+    (optionally) declares a retrace budget for the analysis checker::
+
+        @functools.partial(traced_jit, retrace_budget=16,
+                           static_argnames=("max_j", "k"))
+        def place_kernel(...): ...
+
+    jax is imported lazily at decoration time, so importing this module
+    stays safe in jax-free contexts."""
+    if fn is None:
+        return functools.partial(
+            traced_jit,
+            trace_name=trace_name,
+            retrace_budget=retrace_budget,
+            **jit_kwargs,
+        )
+    import jax
+
+    name = trace_name or f"{fn.__module__}.{fn.__qualname__}"
+    with _trace_lock:
+        _trace_counts.setdefault(name, 0)
+        if retrace_budget is not None:
+            _trace_budgets[name] = retrace_budget
+
+    @functools.wraps(fn)
+    def _counted(*args, **kwargs):
+        record_trace(name)
+        return fn(*args, **kwargs)
+
+    return jax.jit(_counted, **jit_kwargs)
 
 
 def probe_device_count(timeout_s: float = 90.0) -> int:

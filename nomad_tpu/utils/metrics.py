@@ -8,6 +8,7 @@ samples with mean/max, zero dependencies.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from contextlib import contextmanager
@@ -77,3 +78,19 @@ class Metrics:
 
 
 global_metrics = Metrics()
+
+_swallow_log = logging.getLogger("nomad_tpu.swallowed")
+
+
+def count_swallowed(component: str, exc: BaseException | None = None) -> None:
+    """Account an intentionally-swallowed exception: bumps the
+    ``<component>.swallowed_errors`` counter and logs at debug. Every
+    ``except`` that deliberately eats an error in server/broker/state
+    code calls this (or logs outright) — the NTA003 lint rule rejects
+    handlers that do neither, so swallows stay visible on the metrics
+    surface instead of silently zeroing throughput."""
+    global_metrics.incr(f"{component}.swallowed_errors")
+    _swallow_log.debug(
+        "%s: swallowed %s: %s", component, type(exc).__name__ if exc else
+        "error", exc, exc_info=exc is not None,
+    )

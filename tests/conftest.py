@@ -26,3 +26,27 @@ jax.config.update("jax_platforms", "cpu")
 assert (
     jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8
 ), "tests require the 8-device virtual CPU platform"
+
+import pytest  # noqa: E402
+
+# Test modules whose subjects are the lock-heavy subsystems: under
+# NOMAD_TPU_RACECHECK=1 every test in them runs inside a lock-graph
+# detection window (nomad_tpu/analysis/race.py) and fails on lock-order
+# cycles or guarded-field violations even when the timing never fires.
+_RACECHECK_MODULES = {
+    "test_concurrency_invariants",
+    "test_broker",
+    "test_cluster",
+}
+
+
+@pytest.fixture(autouse=True)
+def _lock_graph_racecheck(request):
+    from nomad_tpu.analysis import race
+
+    mod = request.module.__name__.rpartition(".")[2]
+    if not race.enabled() or mod not in _RACECHECK_MODULES:
+        yield
+        return
+    with race.racecheck():
+        yield

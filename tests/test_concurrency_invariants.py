@@ -152,6 +152,73 @@ class TestBrokerPartitionInvariants:
         assert b.ready_count() == 0
 
 
+class TestLockGraphOnRealPaths:
+    """Always-on (not env-gated) lock-graph windows over the same
+    structures the stress tests above hammer: the detector proves the
+    lock ORDER is acyclic even when the timing never wedges."""
+
+    def test_overlay_marker_handoff_is_cycle_free(self):
+        from nomad_tpu.analysis import race
+
+        with race.racecheck() as graph:
+            ov = SharedOverlay()
+            ct = _CT()
+
+            def worker(tid: int):
+                rng = np.random.default_rng(tid)
+                for _ in range(50):
+                    ov.begin_pass(ct)
+                    rows = rng.integers(0, 32, size=4)
+                    ov.add_delta(ct, rows, np.array([1, 0, 0, 0], np.float32))
+                    ov.commit_started()
+                    ov.pass_finished()
+                    ov.commit_finished()
+                    ov.maybe_reset()
+
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        assert graph.cycles() == []
+
+    def test_broker_dequeue_ack_is_cycle_free(self):
+        from nomad_tpu.analysis import race
+
+        with race.racecheck() as graph:
+            b = EvalBroker(n_partitions=2)
+            b.set_enabled(True)
+            b.enqueue_all([
+                Evaluation(
+                    namespace="default", job_id=f"j{i % 5}", type="service",
+                    priority=50, status="pending",
+                )
+                for i in range(40)
+            ])
+
+            def consume(part):
+                while True:
+                    got = b.dequeue_many(
+                        ["service"], 8, timeout=0.2, partition=part
+                    )
+                    if not got:
+                        return
+                    for ev, tok in got:
+                        b.ack(ev.id, tok)
+
+            threads = [
+                threading.Thread(target=consume, args=(p,))
+                for p in (0, 0, 1, 1)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        assert graph.cycles() == []
+
+
 class TestWorkerStats:
     def test_bump_is_atomic_across_threads(self):
         from nomad_tpu.server.worker import Worker

@@ -1,0 +1,722 @@
+"""nomad_tpu.analysis: lint rules (NTA001-005), baseline ratchet, CLI,
+runtime lock-graph race detector, and jit-retrace budget checker.
+
+Every rule gets a trigger + non-trigger fixture through the
+``lint.check_source`` seam (in-memory source, fake in-scope relpath), the
+whole repo is linted against the checked-in baseline (the tier-1 ratchet
+gate), and the CLI is exercised end-to-end as a subprocess: exit 0 at
+HEAD, exit 1 on a seeded violation in a scratch tree.
+
+All tests here are CPU-only and fast — no slow marker, they ride tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.analysis import lint, race, retrace
+from nomad_tpu.analysis.rules import REGISTRY
+from nomad_tpu.analysis.rules.determinism import WallClockInScoringPath
+from nomad_tpu.analysis.rules.hostsync import HostSyncInJitKernel
+from nomad_tpu.analysis.rules.lockfields import LockDiscipline
+from nomad_tpu.analysis.rules.planfreeze import PlanMutationAfterSubmit
+from nomad_tpu.analysis.rules.swallow import SilentExceptionSwallow
+from nomad_tpu.utils import backend
+from nomad_tpu.utils.metrics import count_swallowed, global_metrics
+
+REPO_ROOT = lint.repo_root()
+
+
+def run(src, relpath, rule_cls):
+    return lint.check_source(src, relpath, rules=[rule_cls()])
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# -- NTA001: wall-clock / unseeded randomness in scoring paths -------------
+
+
+class TestNTA001:
+    def test_time_time_in_scheduler_triggers(self):
+        src = "import time\ndef score():\n    return time.time()\n"
+        fs = run(src, "nomad_tpu/scheduler/foo.py", WallClockInScoringPath)
+        assert rule_ids(fs) == ["NTA001"]
+        assert fs[0].symbol == "score"
+
+    def test_datetime_now_triggers(self):
+        src = (
+            "import datetime\n"
+            "def stamp():\n    return datetime.datetime.now()\n"
+        )
+        fs = run(src, "nomad_tpu/device/foo.py", WallClockInScoringPath)
+        assert rule_ids(fs) == ["NTA001"]
+
+    def test_unseeded_random_triggers_seeded_rng_does_not(self):
+        bad = "import random\ndef jitter():\n    return random.random()\n"
+        ok = (
+            "import numpy as np\n"
+            "def jitter(seed):\n"
+            "    return np.random.default_rng(seed).random()\n"
+        )
+        assert rule_ids(
+            run(bad, "nomad_tpu/scheduler/x.py", WallClockInScoringPath)
+        ) == ["NTA001"]
+        assert (
+            run(ok, "nomad_tpu/scheduler/x.py", WallClockInScoringPath) == []
+        )
+
+    def test_injected_clock_is_clean(self):
+        src = "def score(ctx):\n    return ctx.clock()\n"
+        assert (
+            run(src, "nomad_tpu/scheduler/foo.py", WallClockInScoringPath)
+            == []
+        )
+
+    def test_out_of_scope_path_ignored(self):
+        src = "import time\ndef f():\n    return time.time()\n"
+        assert (
+            run(src, "nomad_tpu/server/worker.py", WallClockInScoringPath)
+            == []
+        )
+
+
+# -- NTA002: host sync inside jitted kernels -------------------------------
+
+
+class TestNTA002:
+    def test_item_in_jitted_fn_triggers(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def k(x):\n    return x.sum().item()\n"
+        )
+        fs = run(src, "nomad_tpu/device/score.py", HostSyncInJitKernel)
+        assert rule_ids(fs) == ["NTA002"]
+
+    def test_item_outside_jit_is_clean(self):
+        src = "def host_side(x):\n    return x.sum().item()\n"
+        assert run(src, "nomad_tpu/device/score.py", HostSyncInJitKernel) == []
+
+    def test_traced_jit_partial_decorator_recognized(self):
+        src = (
+            "import functools\n"
+            "from ..utils.backend import traced_jit\n"
+            "@functools.partial(traced_jit, retrace_budget=8)\n"
+            "def k(x):\n    return float(x)\n"
+        )
+        fs = run(src, "nomad_tpu/device/preempt.py", HostSyncInJitKernel)
+        assert rule_ids(fs) == ["NTA002"]
+
+    def test_python_loop_over_array_triggers_range_does_not(self):
+        bad = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def k(xs):\n"
+            "    t = 0\n"
+            "    for x in xs:\n        t = t + x\n"
+            "    return t\n"
+        )
+        ok = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def k(xs):\n"
+            "    t = 0\n"
+            "    for i in range(4):\n        t = t + i\n"
+            "    return t\n"
+        )
+        assert rule_ids(
+            run(bad, "nomad_tpu/device/score.py", HostSyncInJitKernel)
+        ) == ["NTA002"]
+        assert run(ok, "nomad_tpu/device/score.py", HostSyncInJitKernel) == []
+
+    def test_scope_limited_to_device_kernel_files(self):
+        src = "import jax\n@jax.jit\ndef k(x):\n    return x.item()\n"
+        assert (
+            run(src, "nomad_tpu/device/topology.py", HostSyncInJitKernel)
+            == []
+        )
+
+
+# -- NTA003: silent exception swallows -------------------------------------
+
+
+class TestNTA003:
+    def test_pass_only_handler_triggers(self):
+        src = (
+            "def f():\n"
+            "    try:\n        g()\n"
+            "    except ValueError:\n        pass\n"
+        )
+        fs = run(src, "nomad_tpu/server/x.py", SilentExceptionSwallow)
+        assert rule_ids(fs) == ["NTA003"]
+
+    def test_broad_handler_without_observation_triggers(self):
+        src = (
+            "def f():\n"
+            "    try:\n        g()\n"
+            "    except Exception:\n        cleanup()\n"
+        )
+        fs = run(src, "nomad_tpu/broker/x.py", SilentExceptionSwallow)
+        assert rule_ids(fs) == ["NTA003"]
+
+    def test_logging_handler_is_clean(self):
+        src = (
+            "def f():\n"
+            "    try:\n        g()\n"
+            "    except Exception:\n"
+            "        log.exception('g failed')\n"
+        )
+        assert run(src, "nomad_tpu/server/x.py", SilentExceptionSwallow) == []
+
+    def test_count_swallowed_handler_is_clean(self):
+        src = (
+            "def f():\n"
+            "    try:\n        g()\n"
+            "    except Exception as e:\n"
+            "        count_swallowed('worker', e)\n"
+        )
+        assert run(src, "nomad_tpu/server/x.py", SilentExceptionSwallow) == []
+
+    def test_reraise_is_clean(self):
+        src = (
+            "def f():\n"
+            "    try:\n        g()\n"
+            "    except Exception:\n        raise\n"
+        )
+        assert run(src, "nomad_tpu/state/x.py", SilentExceptionSwallow) == []
+
+    def test_scope_excludes_device(self):
+        src = (
+            "def f():\n"
+            "    try:\n        g()\n"
+            "    except Exception:\n        pass\n"
+        )
+        assert run(src, "nomad_tpu/device/x.py", SilentExceptionSwallow) == []
+
+
+# -- NTA004: plan mutation in plan_apply -----------------------------------
+
+
+class TestNTA004:
+    PATH = "nomad_tpu/broker/plan_apply.py"
+
+    def test_attribute_store_on_plan_triggers(self):
+        src = "def apply(plan):\n    plan.priority = 99\n"
+        fs = run(src, self.PATH, PlanMutationAfterSubmit)
+        assert rule_ids(fs) == ["NTA004"]
+
+    def test_mutator_call_on_plan_field_triggers(self):
+        src = (
+            "def apply(plan):\n"
+            "    plan.node_allocs['n1'].append(alloc)\n"
+        )
+        fs = run(src, self.PATH, PlanMutationAfterSubmit)
+        assert rule_ids(fs) == ["NTA004"]
+
+    def test_subscript_store_via_alias_triggers(self):
+        src = (
+            "def apply(plan):\n"
+            "    allocs = plan.node_allocs\n"
+            "    allocs['n1'] = []\n"
+        )
+        fs = run(src, self.PATH, PlanMutationAfterSubmit)
+        assert rule_ids(fs) == ["NTA004"]
+
+    def test_reads_and_local_copies_are_clean(self):
+        src = (
+            "def apply(plan):\n"
+            "    mine = list(plan.node_allocs.get('n1', []))\n"
+            "    mine.append(1)\n"
+            "    return len(mine), plan.priority\n"
+        )
+        assert run(src, self.PATH, PlanMutationAfterSubmit) == []
+
+    def test_scope_limited_to_plan_apply(self):
+        src = "def apply(plan):\n    plan.priority = 99\n"
+        assert (
+            run(src, "nomad_tpu/broker/eval_broker.py",
+                PlanMutationAfterSubmit) == []
+        )
+
+
+# -- NTA005: lock-discipline on guarded fields -----------------------------
+
+
+class TestNTA005:
+    def test_lock_free_read_of_guarded_field_triggers(self):
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._x = 0\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._x += 1\n"
+            "    def peek(self):\n"
+            "        return self._x\n"
+        )
+        fs = run(src, "nomad_tpu/state/s.py", LockDiscipline)
+        assert rule_ids(fs) == ["NTA005"]
+        assert fs[0].symbol == "S.peek"
+
+    def test_all_accesses_locked_is_clean(self):
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._x = 0\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._x += 1\n"
+            "    def peek(self):\n"
+            "        with self._lock:\n"
+            "            return self._x\n"
+        )
+        assert run(src, "nomad_tpu/state/s.py", LockDiscipline) == []
+
+    def test_locked_suffix_method_exempt(self):
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._x = 0\n"
+            "    def bump(self):\n"
+            "        with self._lock:\n"
+            "            self._x += 1\n"
+            "    def _peek_locked(self):\n"
+            "        return self._x\n"
+        )
+        assert run(src, "nomad_tpu/state/s.py", LockDiscipline) == []
+
+    def test_unguarded_fields_not_flagged(self):
+        src = (
+            "import threading\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._x = 0\n"
+            "    def bump(self):\n"
+            "        self._x += 1\n"
+            "    def peek(self):\n"
+            "        return self._x\n"
+        )
+        assert run(src, "nomad_tpu/state/s.py", LockDiscipline) == []
+
+
+# -- suppression + fingerprints --------------------------------------------
+
+
+class TestSuppressionAndFingerprints:
+    SRC = "import time\ndef f():\n    return time.time(){allow}\n"
+
+    def test_bare_allow_waives_all_rules(self):
+        src = self.SRC.format(allow="  # nta: allow")
+        assert lint.check_source(src, "nomad_tpu/scheduler/x.py") == []
+
+    def test_named_allow_waives_only_named_rule(self):
+        src = self.SRC.format(allow="  # nta: allow=NTA001")
+        assert lint.check_source(src, "nomad_tpu/scheduler/x.py") == []
+        src = self.SRC.format(allow="  # nta: allow=NTA003")
+        assert rule_ids(
+            lint.check_source(src, "nomad_tpu/scheduler/x.py")
+        ) == ["NTA001"]
+
+    def test_fingerprint_is_line_number_free(self):
+        src = self.SRC.format(allow="")
+        shifted = "\n\n\n" + src
+        a = lint.check_source(src, "nomad_tpu/scheduler/x.py")
+        b = lint.check_source(shifted, "nomad_tpu/scheduler/x.py")
+        assert a[0].line != b[0].line
+        assert a[0].fingerprint == b[0].fingerprint
+
+    def test_syntax_error_reports_nta000(self):
+        fs = lint.check_source("def f(:\n", "nomad_tpu/scheduler/x.py")
+        assert rule_ids(fs) == ["NTA000"]
+
+
+# -- baseline ratchet -------------------------------------------------------
+
+
+class TestBaselineRatchet:
+    def test_write_is_deterministic_sorted_and_deduped(self, tmp_path):
+        f1 = lint.Finding("NTA001", "b.py", 9, "f", "m")
+        f2 = lint.Finding("NTA001", "a.py", 3, "f", "m")
+        dup = lint.Finding("NTA001", "b.py", 44, "f", "m")  # same print
+        p = tmp_path / "baseline.json"
+        lint.write_baseline([f1, f2, dup], p)
+        first = p.read_text()
+        lint.write_baseline([dup, f2, f1], p)
+        assert p.read_text() == first
+        data = json.loads(first)
+        fps = [e["fingerprint"] for e in data["entries"]]
+        assert fps == sorted(fps) and len(fps) == 2
+
+    def test_diff_reports_new_and_fixed(self):
+        old = lint.Finding("NTA003", "a.py", 1, "f", "old")
+        new = lint.Finding("NTA003", "a.py", 2, "g", "new")
+        baseline = {old.fingerprint}
+        got_new, got_fixed = lint.diff_against_baseline([new], baseline)
+        assert got_new == [new]
+        assert got_fixed == {old.fingerprint}
+
+    def test_whole_repo_has_no_findings_beyond_baseline(self):
+        """The tier-1 gate: everything the engine flags at HEAD is already
+        ratcheted in the checked-in baseline."""
+        findings = lint.run_lint(REPO_ROOT)
+        baseline = lint.load_baseline(lint.default_baseline_path())
+        new, _ = lint.diff_against_baseline(findings, baseline)
+        assert new == [], "new lint findings:\n" + "\n".join(
+            f.render() for f in new
+        )
+
+    def test_registry_covers_all_five_rules(self):
+        assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
+            "NTA001", "NTA002", "NTA003", "NTA004", "NTA005",
+        ]
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def run_cli(*argv, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "nomad_tpu.analysis", *argv],
+        capture_output=True, text=True, cwd=cwd or str(REPO_ROOT),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120,
+    )
+
+
+class TestCLI:
+    def test_exit_zero_at_head(self):
+        r = run_cli()
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "0 new finding(s)" in r.stdout
+
+    def test_seeded_violation_exits_nonzero(self, tmp_path):
+        bad = tmp_path / "nomad_tpu" / "scheduler"
+        bad.mkdir(parents=True)
+        (bad / "bad.py").write_text(
+            "import time\ndef score():\n    return time.time()\n"
+        )
+        empty = tmp_path / "baseline.json"
+        empty.write_text('{"version": 1, "entries": []}\n')
+        r = run_cli("--root", str(tmp_path), "--baseline", str(empty))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "NTA001" in r.stdout
+
+    def test_fix_baseline_then_clean(self, tmp_path):
+        bad = tmp_path / "nomad_tpu" / "server"
+        bad.mkdir(parents=True)
+        (bad / "bad.py").write_text(
+            "def f():\n    try:\n        g()\n"
+            "    except Exception:\n        pass\n"
+        )
+        baseline = tmp_path / "baseline.json"
+        r = run_cli(
+            "--root", str(tmp_path), "--baseline", str(baseline),
+            "--fix-baseline",
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = run_cli("--root", str(tmp_path), "--baseline", str(baseline))
+        assert r.returncode == 0
+        assert "1 ratcheted" in r.stdout
+
+    def test_unknown_rule_exits_two(self):
+        assert run_cli("--rules", "NTA999").returncode == 2
+
+    def test_json_output_parses(self):
+        r = run_cli("--json")
+        data = json.loads(r.stdout)
+        assert data["new"] == [] and data["ratcheted"] >= 0
+
+
+# -- runtime race detector --------------------------------------------------
+
+
+class TestRaceDetector:
+    def test_misordered_two_locks_report_cycle(self):
+        with pytest.raises(race.RaceError, match="lock-order cycle"):
+            with race.racecheck():
+                a = threading.Lock()
+                b = threading.Lock()
+
+                def ab():
+                    with a:
+                        with b:
+                            pass
+
+                def ba():
+                    with b:
+                        with a:
+                            pass
+
+                t1 = threading.Thread(target=ab)
+                t2 = threading.Thread(target=ba)
+                t1.start(); t1.join()
+                t2.start(); t2.join()
+
+    def test_consistent_order_is_clean(self):
+        with race.racecheck() as graph:
+            a = threading.Lock()
+            b = threading.Lock()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+        assert graph.cycles() == []
+
+    def test_unguarded_field_access_recorded(self):
+        class Store:
+            watermark = race.guarded_by("_lock")
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                with self._lock:
+                    self.watermark = 0
+
+        with pytest.raises(race.RaceError, match="unguarded read"):
+            with race.racecheck():
+                s = Store()
+                _ = s.watermark  # read without the lock
+
+    def test_guarded_access_under_lock_is_clean(self):
+        class Store:
+            watermark = race.guarded_by("_lock")
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                with self._lock:
+                    self.watermark = 0
+
+        with race.racecheck():
+            s = Store()
+            with s._lock:
+                s.watermark = 7
+                assert s.watermark == 7
+
+    def test_condition_wait_notify_with_instrumented_rlock(self):
+        with race.racecheck():
+            cond = threading.Condition()
+            ready = []
+
+            def waiter():
+                with cond:
+                    while not ready:
+                        cond.wait(timeout=5)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.02)
+            with cond:
+                ready.append(1)
+                cond.notify_all()
+            t.join(timeout=5)
+            assert not t.is_alive()
+
+    def test_install_uninstall_restores_factories(self):
+        real = threading.Lock
+        g = race.install()
+        try:
+            assert threading.Lock is not real
+            assert race.active_graph() is g
+        finally:
+            race.uninstall()
+        assert threading.Lock is real
+        assert race.active_graph() is None
+
+    def test_broker_plan_queue_path_runs_clean(self):
+        """The real leader path — StateStore + PlanQueue + PlanApplyLoop —
+        with all its locks instrumented: no ordering cycles, no guarded
+        violations (the env-gated tier-1 twin of NOMAD_TPU_RACECHECK=1)."""
+        from nomad_tpu.broker.plan_queue import PlanApplyLoop, PlanQueue
+        from nomad_tpu.state.store import StateStore
+        from nomad_tpu.structs import Plan
+
+        with race.racecheck() as graph:
+            store = StateStore()
+            queue = PlanQueue()
+            queue.set_enabled(True)
+            loop = PlanApplyLoop(store, queue)
+            loop.start()
+            try:
+                futures = []
+
+                def submit():
+                    for p in range(8):
+                        futures.append(queue.enqueue(Plan(priority=p)))
+
+                threads = [threading.Thread(target=submit) for _ in range(3)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=30)
+                for f in futures:
+                    f.result(timeout=30)
+            finally:
+                loop.stop()
+        assert graph.cycles() == []
+        assert graph.field_violations() == []
+
+    def test_eval_broker_path_runs_clean(self):
+        from nomad_tpu.broker.eval_broker import EvalBroker
+        from nomad_tpu.structs import Evaluation
+
+        with race.racecheck():
+            b = EvalBroker(n_partitions=2)
+            b.set_enabled(True)
+            evs = [
+                Evaluation(
+                    namespace="default", job_id=f"j{i}", type="service",
+                    priority=50, status="pending",
+                )
+                for i in range(16)
+            ]
+            b.enqueue_all(evs)
+
+            def consume(part):
+                while True:
+                    got = b.dequeue_many(
+                        ["service"], 4, timeout=0.2, partition=part
+                    )
+                    if not got:
+                        return
+                    for ev, tok in got:
+                        b.ack(ev.id, tok)
+
+            threads = [
+                threading.Thread(target=consume, args=(p,)) for p in (0, 1)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert b.ready_count() == 0
+
+
+# -- jit retrace budgets ----------------------------------------------------
+
+
+class TestRetraceBudgets:
+    def test_traced_jit_counts_traces_not_calls(self):
+        import jax.numpy as jnp
+
+        @backend.traced_jit(trace_name="test.k1", retrace_budget=4)
+        def k1(x):
+            return x * 2
+
+        k1(jnp.ones((3,)))
+        k1(jnp.ones((3,)))  # same shape: cached dispatch, no trace
+        assert retrace.counts()["test.k1"] == 1
+        k1(jnp.ones((5,)))  # new shape: retrace
+        assert retrace.counts()["test.k1"] == 2
+
+    def test_budget_window_raises_past_budget(self):
+        import jax.numpy as jnp
+
+        @backend.traced_jit(trace_name="test.k2", retrace_budget=2)
+        def k2(x):
+            return x + 1
+
+        with pytest.raises(retrace.RetraceBudgetExceeded, match="test.k2"):
+            with retrace.budget_window():
+                for n in range(3, 7):  # 4 distinct shapes > budget 2
+                    k2(jnp.ones((n,)))
+
+    def test_budget_window_scopes_to_deltas(self):
+        import jax.numpy as jnp
+
+        @backend.traced_jit(trace_name="test.k3", retrace_budget=1)
+        def k3(x):
+            return x - 1
+
+        k3(jnp.ones((3,)))  # pre-window trace must not count
+        with retrace.budget_window():
+            k3(jnp.ones((3,)))  # cached: zero traces inside the window
+
+    def test_device_kernels_register_budgets(self):
+        from nomad_tpu.device import preempt, score  # noqa: F401
+
+        budgets = retrace.budgets()
+        for name in (
+            "nomad_tpu.device.score.score_matrix_kernel",
+            "nomad_tpu.device.score.place_closed_form_kernel",
+            "nomad_tpu.device.preempt.find_preemption_kernel",
+        ):
+            assert budgets.get(name, 0) > 0, name
+
+    def test_over_budget_reports_offenders(self):
+        assert retrace.over_budget({"test.k1": 999}) == [
+            ("test.k1", 999, 4)
+        ]
+
+
+# -- satellite: swallowed-error accounting ----------------------------------
+
+
+class TestSwallowAccounting:
+    def _counter(self, name):
+        return global_metrics.snapshot()["counters"].get(name, 0)
+
+    def test_count_swallowed_bumps_component_counter(self):
+        before = self._counter("worker.swallowed_errors")
+        count_swallowed("worker", ValueError("x"))
+        assert self._counter("worker.swallowed_errors") == before + 1
+
+    def test_worker_run_one_failure_is_counted_not_silent(self):
+        from nomad_tpu.server.worker import Worker
+        from nomad_tpu.structs import Evaluation
+
+        class _Broker:
+            def ack(self, *a):
+                raise AssertionError("ack must not be reached")
+
+            def nack(self, *a):
+                raise ValueError("token expired")
+
+        class _Server:
+            eval_broker = _Broker()
+
+        w = Worker.__new__(Worker)
+        w.id = 0
+        w.server = _Server()
+        w.stats = {"processed": 0, "acked": 0, "nacked": 0}
+        w._stats_lock = threading.Lock()
+        w.process_eval = lambda ev, planner: (_ for _ in ()).throw(
+            RuntimeError("scheduler blew up")
+        )
+        ev = Evaluation(
+            namespace="default", job_id="j1", type="service",
+            priority=50, status="pending",
+        )
+        before = self._counter("worker.swallowed_errors")
+        w._run_one(ev, "tok")  # must not raise
+        # one bump for the failed eval, one for the failed nack cleanup
+        assert self._counter("worker.swallowed_errors") == before + 2
+        assert w.stats["nacked"] == 1
+
+
+# -- satellite: injectable scheduler clock ----------------------------------
+
+
+class TestSchedulerClock:
+    def test_generic_scheduler_uses_injected_clock(self):
+        from nomad_tpu.scheduler.generic import GenericScheduler
+
+        s = GenericScheduler(None, None, clock=lambda: 1234.5)
+        assert s.clock() == 1234.5
+
+    def test_default_clock_is_wall_time(self):
+        from nomad_tpu.scheduler.generic import GenericScheduler
+
+        s = GenericScheduler(None, None)
+        assert s.clock is time.time
