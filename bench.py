@@ -286,6 +286,9 @@ def bench_end_to_end(
             if a.job_id.startswith("warmup-") and not a.terminal_status()
         )
         global_metrics.reset()
+        from nomad_tpu.obs import flight_recorder, phase_breakdown
+
+        flight_recorder.clear()
 
         t0 = time.perf_counter()
         for j in range(n_jobs):
@@ -371,6 +374,9 @@ def bench_end_to_end(
                 "full_flattens": server.device_cache.full_flattens,
                 "incremental_refreshes": server.device_cache.incremental_refreshes,
             },
+            # where the eval pipeline spends its time, from the span
+            # traces of the measured run (flight recorder cleared at t0)
+            "phase_breakdown_ms": phase_breakdown(flight_recorder.traces()),
         }
     finally:
         server.shutdown()

@@ -4,7 +4,7 @@ Three engines behind one CLI (``python -m nomad_tpu.analysis``) and one
 fast pytest entry point (tests/test_static_analysis.py):
 
 - ``lint``    — an AST visitor framework plus repo-specific rules
-  (NTA001–NTA005) that encode the invariants the north star depends on
+  (NTA001–NTA006) that encode the invariants the north star depends on
   but the test suite cannot see: trace-pure device kernels, deterministic
   scheduler scoring, observable exception handling, frozen plans after
   submission, and class-level lock discipline.

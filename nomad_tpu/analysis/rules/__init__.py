@@ -7,6 +7,7 @@ from .hostsync import HostSyncInJitKernel  # noqa: E402
 from .swallow import SilentExceptionSwallow  # noqa: E402
 from .planfreeze import PlanMutationAfterSubmit  # noqa: E402
 from .lockfields import LockDiscipline  # noqa: E402
+from .spans import SpanCoverage  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -14,6 +15,7 @@ REGISTRY = [
     SilentExceptionSwallow,  # NTA003
     PlanMutationAfterSubmit,  # NTA004
     LockDiscipline,  # NTA005
+    SpanCoverage,  # NTA006
 ]
 
 __all__ = ["REGISTRY"]

@@ -210,6 +210,15 @@ class HTTPAgent:
                 re.compile(r"^/v1/operator/debug$"),
                 self.handle_operator_debug,
             ),
+            (
+                # flight-recorder surface: recent traces + error events
+                re.compile(r"^/v1/agent/trace$"),
+                self.handle_agent_trace,
+            ),
+            (
+                re.compile(r"^/v1/agent/trace/(?P<eval_id>[^/]+)$"),
+                self.handle_agent_trace,
+            ),
             (re.compile(r"^/v1/status/leader$"), self.handle_leader),
             (re.compile(r"^/v1/metrics$"), self.handle_metrics),
             (re.compile(r"^/v1/acl/bootstrap$"), self.handle_acl_bootstrap),
@@ -1378,6 +1387,26 @@ class HTTPAgent:
         from ..utils.profile import debug_bundle
 
         return debug_bundle(self.server)
+
+    def handle_agent_trace(self, method, body, query, eval_id=None):
+        """/v1/agent/trace[/{eval_id}] — flight-recorder dump: recent
+        completed eval traces (summaries), last-N error events, and the
+        per-kernel jit profile; with an eval id, the full span tree."""
+        self._enforce(query, "agent_read")
+        from ..obs.recorder import flight_recorder
+
+        if eval_id:
+            trace = flight_recorder.get(eval_id)
+            if trace is None:
+                raise APIError(404, f"no trace for eval {eval_id!r}")
+            return trace
+        from ..utils.backend import kernel_profile
+
+        return {
+            "traces": flight_recorder.list(int(query.get("n", 50))),
+            "errors": flight_recorder.errors(),
+            "kernels": kernel_profile(),
+        }
 
     # -- ACL endpoints (nomad/acl_endpoint.go) -----------------------------
     def handle_acl_bootstrap(self, method, body, query):

@@ -79,6 +79,11 @@ class EvalBroker:
         self._delayed: list[tuple] = []
         self._seq = itertools.count()
         self._delivery_count: dict[str, int] = {}
+        # queue-wait attribution for the trace layer: eval id → wall clock
+        # of first readiness, converted at dequeue into a wait the worker
+        # collects via take_queue_wait() for the dequeue span's tags
+        self._enqueued_at: dict[str, float] = {}
+        self._queue_waits: dict[str, float] = {}
         self.stats = {
             "total_ready": 0,
             "total_unacked": 0,
@@ -98,6 +103,8 @@ class EvalBroker:
                 self._in_flight_jobs.clear()
                 self._delayed.clear()
                 self._delivery_count.clear()
+                self._enqueued_at.clear()
+                self._queue_waits.clear()
             self._lock.notify_all()
 
     # -- enqueue -----------------------------------------------------------
@@ -121,6 +128,9 @@ class EvalBroker:
                 self._delayed, (ev.wait_until_unix, next(self._seq), ev)
             )
             return
+        # stamp first readiness (delayed evals stamp when they fire; the
+        # job-gate defer still counts — that IS queue wait for the job)
+        self._enqueued_at.setdefault(ev.id, now)
         job_key = (ev.namespace, ev.job_id)
         if not ignore_job_gate and job_key in self._in_flight_jobs:
             self._pending_by_job.setdefault(job_key, _PQ()).push(ev)
@@ -225,6 +235,9 @@ class EvalBroker:
                     self._delivery_count[ev.id] = (
                         self._delivery_count.get(ev.id, 0) + 1
                     )
+                    t_ready = self._enqueued_at.pop(ev.id, None)
+                    if t_ready is not None:
+                        self._queue_waits[ev.id] = time.time() - t_ready
                     return ev, token
                 if deadline is None:
                     self._lock.wait(min(next_delay, 1.0))
@@ -276,11 +289,19 @@ class EvalBroker:
                 del self._pending_by_job[job_key]
             self._enqueue_locked(nxt)
 
+    def take_queue_wait(self, eval_id: str) -> float:
+        """Pop the ready→dequeue wait recorded for an eval (seconds);
+        0.0 when unknown. The dequeuing worker calls this exactly once to
+        tag the trace's dequeue span, so the table never accumulates."""
+        with self._lock:
+            return self._queue_waits.pop(eval_id, 0.0)
+
     def ack(self, eval_id: str, token: str) -> None:
         with self._lock:
             ev = self._validate(eval_id, token)
             del self._unack[eval_id]
             self._delivery_count.pop(eval_id, None)
+            self._queue_waits.pop(eval_id, None)
             job_key = (ev.namespace, ev.job_id)
             self._in_flight_jobs.discard(job_key)
             self._promote_pending_locked(job_key)
@@ -292,6 +313,7 @@ class EvalBroker:
         with self._lock:
             ev = self._validate(eval_id, token)
             del self._unack[eval_id]
+            self._queue_waits.pop(eval_id, None)
             job_key = (ev.namespace, ev.job_id)
             self._in_flight_jobs.discard(job_key)
             count = self._delivery_count.get(ev.id, 0)

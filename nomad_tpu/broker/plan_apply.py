@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..obs.trace import global_tracer as tracer
 from ..structs import (
     Allocation,
     NetworkIndex,
@@ -26,7 +27,6 @@ from ..structs import (
     PlanResult,
     allocs_fit,
 )
-from ..utils.metrics import global_metrics as metrics
 
 
 def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
@@ -218,23 +218,32 @@ class PlanApplier:
         self._lock = threading.Lock()
 
     def apply(self, plan: Plan) -> PlanResult:
-        with self._lock, metrics.timer("nomad.plan.apply"):
-            with metrics.timer("nomad.plan.evaluate"):
+        with self._lock, tracer.span(
+            "plan_apply", timer="nomad.plan.apply"
+        ) as sp:
+            with tracer.span(
+                "plan_apply.evaluate", timer="nomad.plan.evaluate"
+            ):
                 result = evaluate_plan(self.store, plan)
+            if sp is not None:
+                sp.tags["rejected_nodes"] = len(result.rejected_nodes)
             if not result.is_no_op() or result.deployment is not None:
                 evals = (
                     preemption_evals(self.store, result)
                     if result.node_preemptions else []
                 )
-                if self.commit is not None:
-                    index = self.commit(result, plan.eval_id, evals)
-                else:
-                    index = self.store.latest_index + 1
-                    self.store.upsert_plan_results(index, result, plan.eval_id)
-                    if evals:
-                        self.store.upsert_evals(
-                            self.store.latest_index + 1, evals
+                with tracer.span("plan_apply.commit"):
+                    if self.commit is not None:
+                        index = self.commit(result, plan.eval_id, evals)
+                    else:
+                        index = self.store.latest_index + 1
+                        self.store.upsert_plan_results(
+                            index, result, plan.eval_id
                         )
+                        if evals:
+                            self.store.upsert_evals(
+                                self.store.latest_index + 1, evals
+                            )
                 result.alloc_index = index
                 if evals and self.on_evals_created is not None:
                     # re-read post-commit: a consensus FSM applies COPIES,

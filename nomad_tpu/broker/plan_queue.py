@@ -15,20 +15,26 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from concurrent.futures import Future
 from typing import Optional
 
+from ..obs.trace import global_tracer as tracer
 from ..structs import Plan, PlanResult
 from ..utils.metrics import global_metrics as metrics
 from .plan_apply import PlanApplier
 
 
 class PendingPlan:
-    __slots__ = ("plan", "future")
+    __slots__ = ("plan", "future", "trace_ctx", "enqueued_at")
 
-    def __init__(self, plan: Plan):
+    def __init__(self, plan: Plan, trace_ctx=None):
         self.plan = plan
         self.future: Future[PlanResult] = Future()
+        # the submitting worker's span context rides the queue so the
+        # applier thread parents its spans into the right eval trace
+        self.trace_ctx = trace_ctx
+        self.enqueued_at = time.perf_counter()
 
 
 class PlanQueue:
@@ -53,7 +59,7 @@ class PlanQueue:
                 f: Future = Future()
                 f.set_exception(RuntimeError("plan queue is disabled"))
                 return f
-            pending = PendingPlan(plan)
+            pending = PendingPlan(plan, trace_ctx=tracer.current_ctx())
             heapq.heappush(self._heap, (-plan.priority, next(self._c), pending))
             metrics.set_gauge("nomad.plan.queue_depth", len(self._heap))
             self._lock.notify_all()
@@ -101,8 +107,19 @@ class PlanApplyLoop:
             pending = self.queue.pop(timeout=0.2)
             if pending is None:
                 continue
+            ctx = pending.trace_ctx
+            if ctx is not None:
+                tracer.add_span(
+                    ctx.trace_id,
+                    "plan_queue.wait",
+                    time.perf_counter() - pending.enqueued_at,
+                    parent=ctx,
+                )
             try:
-                result = self.applier.apply(pending.plan)
+                # cross-thread adoption: plan_apply spans below parent
+                # under the worker's submit_plan span
+                with tracer.attach(ctx):
+                    result = self.applier.apply(pending.plan)
                 pending.future.set_result(result)
             except Exception as e:  # noqa: BLE001 — propagate to waiter
                 pending.future.set_exception(e)

@@ -621,6 +621,45 @@ def cmd_operator_metrics(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """`nomad-tpu trace [eval_id]` — flight-recorder view. Without an
+    id: recent completed traces + last error events. With one: the full
+    span tree rendered as an indented duration breakdown."""
+    c = _client(args)
+    if args.eval_id:
+        try:
+            tr = c._request("GET", f"/v1/agent/trace/{args.eval_id}")
+        except APIException as e:
+            return _fail(str(e))
+        if args.json:
+            print(json.dumps(tr, indent=2))
+        else:
+            from ..obs.recorder import render_trace
+
+            print(render_trace(tr))
+        return 0
+    out = c._request("GET", "/v1/agent/trace")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    traces = out.get("traces", [])
+    if not traces:
+        print("no completed traces recorded")
+    for t in traces:
+        print(
+            f"{t['eval_id']}  {t['status']:<7} "
+            f"{t['duration_ms']:>9.2f}ms  {t['spans']:>3} spans  "
+            + ",".join(f"{k}={v}" for k, v in sorted(t["tags"].items()))
+        )
+    errors = out.get("errors", [])
+    if errors:
+        print(f"\n{len(errors)} recent error event(s):")
+        for ev in errors[:10]:
+            tail = f"  eval={ev['eval_id']}" if ev.get("eval_id") else ""
+            print(f"  [{ev['component']}] {ev['error']}{tail}")
+    return 0
+
+
 def cmd_scaling_policies(args) -> int:
     """`nomad scaling policy list` (command/scaling_policy_list.go)."""
     c = _client(args)
@@ -1059,6 +1098,11 @@ def build_parser() -> argparse.ArgumentParser:
     atdel = atok.add_parser("delete")
     atdel.add_argument("accessor")
     atdel.set_defaults(fn=cmd_acl_token_delete)
+
+    tr = sub.add_parser("trace", help="show recent eval traces")
+    tr.add_argument("eval_id", nargs="?", default="")
+    tr.add_argument("-json", action="store_true")
+    tr.set_defaults(fn=cmd_trace)
 
     ver = sub.add_parser("version", help="show version")
     ver.set_defaults(fn=cmd_version)

@@ -61,6 +61,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.trace import global_tracer as _tracer
 from ..structs.resources import BINPACK_MAX_SCORE
 from ..utils.backend import traced_jit
 
@@ -1073,21 +1074,34 @@ class PlacementKernel:
             else:
                 fast.append(i)
         out: list[Optional[PlacementResult]] = [None] * len(asks)
-        for idxs, fn in (
-            (fast, self._place_closed_form),
-            (chunked, self._place_spread_chunked),
-            (opv, self._place_spread_opv),
-            (scan, self._place_scan_batch),
+        # the span carries the routing split so a trace shows WHICH
+        # kernel family scored each pass (jit-level detail — compile
+        # events, shapes — attaches underneath via traced_jit's hooks)
+        with _tracer.span(
+            "kernel.place",
+            tags={
+                "lanes": len(asks),
+                "fast": len(fast),
+                "chunked": len(chunked),
+                "opv": len(opv),
+                "scan": len(scan),
+            },
         ):
-            if idxs:
-                for i, r in zip(
-                    idxs,
-                    fn(
-                        cluster, [work[i] for i in idxs], overflow, jitter,
-                        used0,
-                    ),
-                ):
-                    out[i] = r
+            for idxs, fn in (
+                (fast, self._place_closed_form),
+                (chunked, self._place_spread_chunked),
+                (opv, self._place_spread_opv),
+                (scan, self._place_scan_batch),
+            ):
+                if idxs:
+                    for i, r in zip(
+                        idxs,
+                        fn(
+                            cluster, [work[i] for i in idxs], overflow,
+                            jitter, used0,
+                        ),
+                    ):
+                        out[i] = r
         return out
 
     @staticmethod

@@ -1,0 +1,32 @@
+"""nomad_tpu.obs — zero-dependency tracing + profiling.
+
+Three parts (see trace.py / recorder.py and utils/backend.py):
+
+- **Spans**: ``global_tracer`` keys one trace tree per eval id and
+  carries it across the worker → plan-queue → applier thread handoff.
+- **Kernel profiling**: ``utils/backend.traced_jit`` reports per-kernel
+  wall time, compile events and the abstract shapes that triggered them,
+  attached to the enclosing span when one is active.
+- **Flight recorder**: ``flight_recorder`` rings the last N completed
+  traces + error events, surfaced at ``/v1/agent/trace`` and rendered by
+  the ``nomad-tpu trace`` CLI.
+"""
+
+from .recorder import (
+    FlightRecorder,
+    flight_recorder,
+    phase_breakdown,
+    render_trace,
+)
+from .trace import Span, SpanContext, Tracer, global_tracer
+
+__all__ = [
+    "FlightRecorder",
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "flight_recorder",
+    "global_tracer",
+    "phase_breakdown",
+    "render_trace",
+]

@@ -1,0 +1,176 @@
+"""Flight recorder — fixed-size ring of recent completed traces.
+
+The analog of an aircraft FDR for the scheduler: the last N eval traces
+and the last N error events stay resident, cheap enough to leave on in
+production, and are surfaced at ``/v1/agent/trace`` next to
+``/v1/metrics``. ``render_trace`` turns one recorded tree into the
+indented duration view the ``nomad-tpu trace`` CLI prints;
+``phase_breakdown`` aggregates span durations by name for the BENCH
+per-phase report.
+
+Zero dependencies beyond the stdlib; traces arrive as plain dicts (see
+``Tracer.finish``) so the recorder never holds live Span objects.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+DEFAULT_CAPACITY = 256
+DEFAULT_ERROR_CAPACITY = 100
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        capacity: int = DEFAULT_CAPACITY,
+        error_capacity: int = DEFAULT_ERROR_CAPACITY,
+    ):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        # eval_id → trace dict, insertion-ordered: oldest first, evicted
+        # first; a re-processed eval re-records and moves to the tail
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self._errors: deque = deque(maxlen=error_capacity)
+
+    # -- writes ------------------------------------------------------------
+    def record(self, trace: dict) -> None:
+        eval_id = trace.get("eval_id", "")
+        with self._lock:
+            if eval_id in self._traces:
+                del self._traces[eval_id]
+            self._traces[eval_id] = trace
+            while len(self._traces) > self.capacity:
+                self._traces.popitem(last=False)
+
+    def record_error(
+        self, component: str, error: str, eval_id: str = ""
+    ) -> None:
+        with self._lock:
+            self._errors.append(
+                {
+                    "at_unix": time.time(),
+                    "component": component,
+                    "error": error,
+                    "eval_id": eval_id,
+                }
+            )
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+            self._errors.clear()
+
+    # -- reads -------------------------------------------------------------
+    def get(self, eval_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._traces.get(eval_id)
+
+    def traces(self) -> list[dict]:
+        """Full trace dicts, newest first."""
+        with self._lock:
+            return list(reversed(self._traces.values()))
+
+    def list(self, n: int = 50) -> list[dict]:
+        """Newest-first summaries (the trace index endpoint)."""
+        out = []
+        for t in self.traces()[: max(0, n)]:
+            out.append(
+                {
+                    "eval_id": t.get("eval_id", ""),
+                    "status": t.get("status", ""),
+                    "started_at": t.get("started_at", 0.0),
+                    "duration_ms": t.get("duration_ms", 0.0),
+                    "spans": len(t.get("spans", ())),
+                    "tags": t.get("tags", {}),
+                }
+            )
+        return out
+
+    def errors(self) -> list[dict]:
+        with self._lock:
+            return list(reversed(self._errors))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+
+flight_recorder = FlightRecorder()
+
+
+def render_trace(trace: dict) -> str:
+    """Render one recorded trace as an indented duration tree::
+
+        eval 4bb1…  acked  12.41ms  job_id=bench-3
+          dequeue              0.31ms  queue_wait_ms=0.21
+          wait_for_index       0.02ms
+          ...
+    """
+    spans = trace.get("spans", [])
+    children: dict = {}
+    roots = []
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid is None:
+            roots.append(s)
+        else:
+            children.setdefault(pid, []).append(s)
+
+    def fmt_tags(tags: dict) -> str:
+        return " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+
+    header_tags = fmt_tags(trace.get("tags", {}))
+    lines = [
+        f"eval {trace.get('eval_id', '?')}  {trace.get('status', '?')}  "
+        f"{trace.get('duration_ms', 0.0):.2f}ms"
+        + (f"  {header_tags}" if header_tags else "")
+    ]
+
+    def walk(span: dict, depth: int) -> None:
+        tags = fmt_tags(span.get("tags", {}))
+        name = "  " * depth + span["name"]
+        lines.append(
+            f"{name:<40s} {span.get('duration_ms', 0.0):>10.2f}ms"
+            + (f"  {tags}" if tags else "")
+        )
+        kids = children.get(span.get("span_id"), [])
+        for kid in sorted(kids, key=lambda s: s.get("start_unix", 0.0)):
+            walk(kid, depth + 1)
+
+    for root in roots:
+        for kid in sorted(
+            children.get(root.get("span_id"), []),
+            key=lambda s: s.get("start_unix", 0.0),
+        ):
+            walk(kid, 1)
+    return "\n".join(lines)
+
+
+def phase_breakdown(traces: list[dict]) -> dict:
+    """Aggregate span durations by name across traces — the BENCH
+    per-phase latency table. Root spans are excluded (the root is the
+    whole eval; the phases are its children)."""
+    by_name: dict[str, list[float]] = {}
+    for t in traces:
+        for s in t.get("spans", ()):
+            if s.get("parent_id") is None:
+                continue
+            by_name.setdefault(s["name"], []).append(
+                float(s.get("duration_ms") or 0.0)
+            )
+    out = {}
+    for name in sorted(by_name):
+        buf = sorted(by_name[name])
+        n = len(buf)
+        p95 = buf[min(n - 1, int(round(0.95 * (n - 1))))]
+        out[name] = {
+            "count": n,
+            "mean_ms": round(sum(buf) / n, 3),
+            "p95_ms": round(p95, 3),
+            "max_ms": round(buf[-1], 3),
+        }
+    return out

@@ -17,6 +17,7 @@ from typing import Optional
 
 from ..device import PlacementKernel, flatten_group_ask
 from ..device.cache import DeviceStateCache
+from ..obs.trace import global_tracer as tracer
 from ..structs import (
     ALLOC_DESIRED_RUN,
     EVAL_STATUS_COMPLETE,
@@ -158,25 +159,27 @@ class GenericScheduler:
             if self.overlay is not None:
                 used_override = self.overlay.begin_pass(ct)
             try:
-                results = self.kernel.place(
-                    ct, asks, used_override=used_override
-                )
-                # the repair walk is also the single-eval safety net: it
-                # resolves cross-TG conflicts within this plan and
-                # re-places kernel shortfalls (e.g. chunked-path
-                # truncation) by exact host re-score before they read as
-                # placement failures
-                from ..device.score import repair_batch_conflicts
+                with tracer.span("kernel_score", tags={"lanes": len(asks)}):
+                    results = self.kernel.place(
+                        ct, asks, used_override=used_override
+                    )
+                    # the repair walk is also the single-eval safety net:
+                    # it resolves cross-TG conflicts within this plan and
+                    # re-places kernel shortfalls (e.g. chunked-path
+                    # truncation) by exact host re-score before they read
+                    # as placement failures
+                    from ..device.score import repair_batch_conflicts
 
-                repair_batch_conflicts(
-                    ct, asks, results,
-                    algorithm_spread=self.kernel.algorithm_spread,
-                    # single-eval: no fresh state to re-run against, so
-                    # an unplaceable placement fails into the blocked-
-                    # eval accounting instead of aborting the lane
-                    fail_on_contention=True,
-                    used_override=used_override,
-                )
+                    repair_batch_conflicts(
+                        ct, asks, results,
+                        algorithm_spread=self.kernel.algorithm_spread,
+                        # single-eval: no fresh state to re-run against,
+                        # so an unplaceable placement fails into the
+                        # blocked-eval accounting instead of aborting the
+                        # lane
+                        fail_on_contention=True,
+                        used_override=used_override,
+                    )
                 if self.overlay is not None:
                     for a, res in zip(asks, results):
                         rows = res.node_rows[res.node_rows >= 0]

@@ -29,8 +29,8 @@ import threading
 from typing import Dict, Optional
 
 from ..raft import NotLeaderError, RaftNode
-from ..utils.metrics import global_metrics as metrics
 from ..raft.node import RaftConfig
+from ..utils.metrics import count_swallowed
 from ..rpc import RPCClient, RPCServer
 from ..state.snapshot import restore_snapshot, save_snapshot
 from .server import Server, ServerConfig
@@ -187,18 +187,18 @@ class ClusterServer:
                 removed.append(pid)
                 peers = self.raft.peers()
                 log.info("autopilot: removed dead server %s", pid)
-            except Exception:
+            except Exception as e:
                 log.exception("autopilot: remove_peer %s failed", pid)
-                metrics.incr("cluster.swallowed_errors")
+                count_swallowed("cluster", e)
         return removed
 
     def _autopilot_loop(self) -> None:
         while not self._autopilot_stop.wait(self.autopilot_interval):
             try:
                 self.autopilot_sweep()
-            except Exception:
+            except Exception as e:
                 log.exception("autopilot sweep failed")
-                metrics.incr("cluster.swallowed_errors")
+                count_swallowed("cluster", e)
 
     # -- leadership hooks (leader.go monitorLeadership) --------------------
     def _on_leader(self) -> None:

@@ -26,10 +26,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
+from ..obs.trace import global_tracer as tracer
 from ..scheduler import new_scheduler
 from ..structs import Evaluation, Plan
 from ..utils.metrics import count_swallowed
@@ -86,13 +88,23 @@ class _TokenPlanner:
         plan.eval_token = self.token
         plan.normalize()
         server = self._worker.server
-        with metrics.timer("nomad.worker.submit_plan"):
+        # the enqueue captures this span's context onto the pending plan,
+        # so the applier thread's plan_apply spans parent under it
+        with tracer.span(
+            "submit_plan", timer="nomad.worker.submit_plan"
+        ) as sp:
             future = server.plan_queue.enqueue(plan)
             result = future.result(timeout=30)
+            if sp is not None:
+                sp.tags["rejected_nodes"] = len(result.rejected_nodes)
         new_snapshot = None
         if result.refresh_index:
-            server.store.wait_for_index(result.refresh_index, timeout=5.0)
-            new_snapshot = server.store.snapshot()
+            with tracer.span(
+                "refresh_snapshot",
+                tags={"refresh_index": result.refresh_index},
+            ):
+                server.store.wait_for_index(result.refresh_index, timeout=5.0)
+                new_snapshot = server.store.snapshot()
         return result, new_snapshot
 
     def update_eval(self, ev: Evaluation) -> None:
@@ -164,19 +176,47 @@ class Worker:
                 continue
             n_batchers = getattr(self.server.config, "num_batch_workers", 1)
             batching = self.id < n_batchers
-            with metrics.timer("nomad.worker.dequeue_eval"):
-                batch = self.server.eval_broker.dequeue_many(
-                    self.schedulers,
-                    EVAL_BATCH_SIZE if batching else 1,
-                    timeout=0.2,
-                    # each batching worker owns one job-hash partition so
-                    # two batched passes never share a job set; solo
-                    # workers scan every partition
-                    partition=self.id if batching and n_batchers > 1 else None,
-                )
+            # pre-trace interval: no eval (hence no trace) exists until the
+            # dequeue returns — the sample feeds /v1/metrics directly and
+            # the span is attached retroactively per dequeued eval below
+            t0 = time.perf_counter()
+            batch = self.server.eval_broker.dequeue_many(
+                self.schedulers,
+                EVAL_BATCH_SIZE if batching else 1,
+                timeout=0.2,
+                # each batching worker owns one job-hash partition so
+                # two batched passes never share a job set; solo
+                # workers scan every partition
+                partition=self.id if batching and n_batchers > 1 else None,
+            )
+            dequeue_s = time.perf_counter() - t0
+            metrics.measure("nomad.worker.dequeue_eval", dequeue_s)
             if not batch:
                 self._join_commit()
                 continue
+            for ev, _token in batch:
+                queue_wait = self.server.eval_broker.take_queue_wait(ev.id)
+                root = tracer.begin(
+                    ev.id,
+                    tags={
+                        "job_id": ev.job_id,
+                        "namespace": ev.namespace,
+                        "type": ev.type,
+                        "triggered_by": ev.triggered_by,
+                        "worker": self.id,
+                        "batch_size": len(batch),
+                    },
+                )
+                if root is not None:
+                    tracer.add_span(
+                        ev.id,
+                        "dequeue",
+                        dequeue_s,
+                        tags={
+                            "queue_wait_ms": round(queue_wait * 1000.0, 3),
+                            "shared": len(batch) > 1,
+                        },
+                    )
             try:
                 if len(batch) == 1:
                     # batch accounting reconciliation: evals dequeued solo
@@ -185,35 +225,43 @@ class Worker:
                     self._run_one(*batch[0])
                 else:
                     self._run_batch(batch)
-            except Exception:
+            except Exception as e:
                 # a worker thread must never die silently: dequeued evals
                 # would stay unacked forever and per-job serialization
                 # would wedge those jobs (the broker has no redelivery
                 # deadline). Nack everything still outstanding.
                 log.exception("worker %d: batch failed", self.id)
-                metrics.incr("worker.swallowed_errors")
+                count_swallowed("worker", e)
                 for ev, token in batch:
                     try:
                         self.server.eval_broker.nack(ev.id, token)
                         self._bump("nacked")
-                    except ValueError as e:
-                        count_swallowed("worker", e)  # already acked/nacked
+                    except ValueError as e2:
+                        count_swallowed("worker", e2)  # already acked/nacked
+                    tracer.finish(ev.id, status="nacked", error=repr(e))
         self._join_commit()
 
     def _run_one(self, ev: Evaluation, token: str) -> None:
         planner = _TokenPlanner(self, token)
+        # idempotent: run() already opened the trace for dequeued evals;
+        # this covers direct callers (tests, batch single-path fallbacks
+        # keep appending to the tree they started in)
+        tracer.begin(ev.id, tags={"job_id": ev.job_id, "type": ev.type})
         try:
-            self.process_eval(ev, planner)
+            with tracer.activate(ev.id):
+                self.process_eval(ev, planner)
             self.server.eval_broker.ack(ev.id, token)
             self._bump("acked")
-        except Exception:
+            tracer.finish(ev.id, status="acked")
+        except Exception as e:
             log.exception("worker %d: eval %s failed", self.id, ev.id)
-            metrics.incr("worker.swallowed_errors")
+            count_swallowed("worker", e)
             try:
                 self.server.eval_broker.nack(ev.id, token)
-            except ValueError as e:
-                count_swallowed("worker", e)
+            except ValueError as e2:
+                count_swallowed("worker", e2)
             self._bump("nacked", "processed")
+            tracer.finish(ev.id, status="nacked", error=repr(e))
         # per-eval counter: the invoke_scheduler TIMER emits one sample per
         # batched pass, so throughput accounting reads this counter instead
         metrics.incr("nomad.worker.evals_processed")
@@ -234,10 +282,13 @@ class Worker:
             self._join_commit()
         if self.server.placement_overlay.maybe_reset():
             metrics.incr("nomad.worker.pipeline_epoch_resets")
-        with metrics.timer("nomad.worker.wait_for_index"):
-            self.server.store.wait_for_index(
-                max(ev.modify_index for ev, _ in batch), timeout=5.0
-            )
+        t0 = time.perf_counter()
+        self.server.store.wait_for_index(
+            max(ev.modify_index for ev, _ in batch), timeout=5.0
+        )
+        wfi_s = time.perf_counter() - t0
+        metrics.measure("nomad.worker.wait_for_index", wfi_s)
+        t0 = time.perf_counter()
         snapshot = self.server.store.snapshot()
         # One ClusterTensors for the WHOLE batch: if each scheduler fetched
         # its own, a concurrent worker advancing the cache generation
@@ -246,6 +297,12 @@ class Worker:
         # would silently misalign with the capacity/used arrays in the
         # combined kernel call.
         ct = self.server.device_cache.tensors(snapshot)
+        snap_s = time.perf_counter() - t0
+        # shared phases happen once for the whole batch; record the same
+        # interval into every member's trace, tagged shared
+        for ev, _tok in batch:
+            tracer.add_span(ev.id, "wait_for_index", wfi_s, tags={"shared": True})
+            tracer.add_span(ev.id, "snapshot", snap_s, tags={"shared": True})
 
         prepared = []  # (ev, token, sched, n_asks)
         all_asks: list = []
@@ -262,14 +319,16 @@ class Worker:
                 cache=self.server.device_cache,
                 overlay=self.server.placement_overlay,
             )
+            t0 = time.perf_counter()
             try:
                 asks = sched.prepare_batch_attempt(ev, ct=ct)
-            except Exception:
+            except Exception as e:
                 log.exception("worker %d: batch prepare %s", self.id, ev.id)
-                metrics.incr("worker.swallowed_errors")
+                count_swallowed("worker", e)
                 asks = None
                 singles.append((ev, token))
                 continue
+            tracer.add_span(ev.id, "prepare", time.perf_counter() - t0)
             if asks is None:
                 singles.append((ev, token))
             else:
@@ -291,41 +350,54 @@ class Worker:
                 metrics.incr("nomad.worker.pipeline_override_passes")
             try:
                 kernel = prepared[0][2].kernel
-                with metrics.timer("nomad.worker.invoke_scheduler"):
-                    # decorrelate: each lane scores a disjoint node stripe
-                    # (the vector analog of per-worker shuffle sampling,
-                    # stack.go:74-90) so concurrent lanes stop argmaxing
-                    # onto the same nodes; repair re-scores any remainder
-                    results = kernel.place(
-                        ct,
-                        all_asks,
-                        decorrelate=True,
-                        decorrelate_salt=self.id,
-                        # concurrent batchers carve disjoint node slices
-                        decorrelate_workers=getattr(
-                            self.server.config, "num_batch_workers", 1
-                        ),
-                        overflow=32,
-                        used_override=used_override,
-                    )
-                    from ..device.score import repair_batch_conflicts
+                t0 = time.perf_counter()
+                # decorrelate: each lane scores a disjoint node stripe
+                # (the vector analog of per-worker shuffle sampling,
+                # stack.go:74-90) so concurrent lanes stop argmaxing
+                # onto the same nodes; repair re-scores any remainder
+                results = kernel.place(
+                    ct,
+                    all_asks,
+                    decorrelate=True,
+                    decorrelate_salt=self.id,
+                    # concurrent batchers carve disjoint node slices
+                    decorrelate_workers=getattr(
+                        self.server.config, "num_batch_workers", 1
+                    ),
+                    overflow=32,
+                    used_override=used_override,
+                )
+                from ..device.score import repair_batch_conflicts
 
-                    lane_ok = repair_batch_conflicts(
-                        ct,
-                        all_asks,
-                        results,
-                        algorithm_spread=kernel.algorithm_spread,
-                        # multi-TG evals span lanes; a failed lane
-                        # discards the WHOLE eval, so repair must release
-                        # (and stop reserving for) every sibling lane too
-                        lane_groups=lane_groups,
-                        used_override=used_override,
+                lane_ok = repair_batch_conflicts(
+                    ct,
+                    all_asks,
+                    results,
+                    algorithm_spread=kernel.algorithm_spread,
+                    # multi-TG evals span lanes; a failed lane
+                    # discards the WHOLE eval, so repair must release
+                    # (and stop reserving for) every sibling lane too
+                    lane_groups=lane_groups,
+                    used_override=used_override,
+                )
+                invoke_s = time.perf_counter() - t0
+                metrics.measure("nomad.worker.invoke_scheduler", invoke_s)
+                for ev, _tok, _sched, _n in prepared:
+                    tracer.add_span(
+                        ev.id,
+                        "invoke_scheduler",
+                        invoke_s,
+                        tags={
+                            "shared": True,
+                            "evals": len(prepared),
+                            "lanes": len(all_asks),
+                        },
                     )
-            except Exception:
+            except Exception as e:
                 # shared pass failed — every prepared eval falls back to
                 # the individual path rather than dying unacked
                 log.exception("worker %d: combined kernel pass", self.id)
-                metrics.incr("worker.swallowed_errors")
+                count_swallowed("worker", e)
                 metrics.incr("nomad.worker.batch_kernel_errors")
                 singles.extend((ev, token) for ev, token, _, _ in prepared)
                 prepared = []
@@ -403,51 +475,63 @@ class Worker:
                     singles.append((ev, token))
                     continue
                 try:
-                    if sched.complete_batch_attempt(span):
+                    # adopt this eval's trace on the commit thread so the
+                    # submit_plan → plan_apply spans parent into it
+                    with tracer.activate(ev.id):
+                        completed = sched.complete_batch_attempt(span)
+                    if completed:
                         self.server.eval_broker.ack(ev.id, token)
                         self._bump("acked", "processed")
                         metrics.incr("nomad.worker.batch_evals_completed")
                         metrics.incr("nomad.worker.evals_processed")
+                        tracer.finish(ev.id, status="acked")
                     else:
                         # optimistic conflict: re-run individually on
-                        # fresh state
+                        # fresh state (the trace stays open; _run_one
+                        # below appends the retry attempt and finishes it)
                         metrics.incr("nomad.worker.batch_conflict_fallbacks")
                         metrics.incr("nomad.worker.batch_commit_fallbacks")
                         singles.append((ev, token))
-                except Exception:
+                except Exception as e:
                     log.exception(
                         "worker %d: batch complete %s", self.id, ev.id
                     )
-                    metrics.incr("worker.swallowed_errors")
+                    count_swallowed("worker", e)
                     try:
                         self.server.eval_broker.nack(ev.id, token)
-                    except ValueError as e:
-                        count_swallowed("worker", e)
+                    except ValueError as e2:
+                        count_swallowed("worker", e2)
                     self._bump("nacked", "processed")
                     metrics.incr("nomad.worker.evals_processed")
+                    tracer.finish(ev.id, status="nacked", error=repr(e))
 
             for ev, token in singles:
                 metrics.incr("nomad.worker.batch_single_fallbacks")
                 self._run_one(ev, token)
-        except Exception:
+        except Exception as e:
             # the commit thread must never die with evals unacked —
             # including the singles that accumulated from fallbacks
             log.exception("worker %d: commit thread failed", self.id)
-            metrics.incr("worker.swallowed_errors")
+            count_swallowed("worker", e)
             outstanding = [
                 (ev, token) for ev, token, _s, _n in prepared
             ] + list(singles)
             for ev, token in outstanding:
                 try:
                     self.server.eval_broker.nack(ev.id, token)
-                except Exception as e:  # best-effort cleanup
-                    count_swallowed("worker", e)
+                except Exception as e2:  # best-effort cleanup
+                    count_swallowed("worker", e2)
+                # finish() no-ops for evals already acked/finished above
+                tracer.finish(ev.id, status="nacked", error=repr(e))
 
     def process_eval(self, ev: Evaluation, planner=None) -> None:
         # raft catch-up barrier (worker.go:536-549)
-        with metrics.timer("nomad.worker.wait_for_index"):
+        with tracer.span(
+            "wait_for_index", timer="nomad.worker.wait_for_index"
+        ):
             self.server.store.wait_for_index(ev.modify_index, timeout=5.0)
-        snapshot = self.server.store.snapshot()
+        with tracer.span("snapshot"):
+            snapshot = self.server.store.snapshot()
         # all workers share the server's resident device-state cache —
         # tensors refresh incrementally by state index, not per eval
         sched = new_scheduler(
@@ -457,7 +541,9 @@ class Worker:
             cache=self.server.device_cache,
             overlay=self.server.placement_overlay,
         )
-        with metrics.timer("nomad.worker.invoke_scheduler"):
+        with tracer.span(
+            "invoke_scheduler", timer="nomad.worker.invoke_scheduler"
+        ):
             sched.process(ev)
 
     # -- Planner interface kept for direct (non-batch) callers -------------

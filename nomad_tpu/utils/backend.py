@@ -11,6 +11,7 @@ hazard; this module is the single copy of the workaround.
 import functools
 import os
 import threading
+import time
 
 # -- jit trace accounting ----------------------------------------------------
 #
@@ -28,10 +29,100 @@ _trace_lock = threading.Lock()
 _trace_counts: dict[str, int] = {}
 _trace_budgets: dict[str, int] = {}
 
+# -- kernel profiling (nomad_tpu.obs) ----------------------------------------
+#
+# Per-kernel call/compile accounting behind the same lock: every
+# traced_jit call records its dispatch wall time; calls that triggered an
+# XLA trace additionally record the abstract batch shape that caused it
+# and land in a bounded recent-events list. Caveat, stated honestly:
+# dispatch wall time UNDERESTIMATES device execute time under jax's
+# async dispatch (we deliberately do not block_until_ready — profiling
+# must not change the pipeline), while a trace-triggering call's wall
+# time INCLUDES trace+compile, which is why those are exported as a
+# separate ``.compile`` sample series.
+
+_KERNEL_TRACE_EVENTS = 32  # recent trace events kept per kernel
+
+_kernel_stats: dict[str, dict] = {}
+_kernel_traces: dict[str, list[dict]] = {}
+_last_trace_shape: dict[str, str] = {}
+
+_obs_tracer = None  # lazily bound nomad_tpu.obs.trace.global_tracer
+
 
 def record_trace(name: str) -> None:
     with _trace_lock:
         _trace_counts[name] = _trace_counts.get(name, 0) + 1
+
+
+def _shape_sig(args, kwargs) -> str:
+    """Abstract signature of a kernel call — built only at trace time,
+    when the positional args are jax tracers carrying shape/dtype."""
+    parts = []
+    for a in list(args) + [v for _, v in sorted(kwargs.items())]:
+        shp = getattr(a, "shape", None)
+        if shp is not None:
+            dt = getattr(getattr(a, "dtype", None), "name", "?")
+            parts.append(f"{dt}[{','.join(str(d) for d in shp)}]")
+        elif isinstance(a, (bool, int, float, str)):
+            parts.append(repr(a))
+    return " ".join(parts)[:256]
+
+
+def _record_kernel_call(
+    name: str, short: str, seconds: float, traced: bool
+) -> None:
+    with _trace_lock:
+        st = _kernel_stats.setdefault(
+            name, {"calls": 0, "traces": 0, "total_s": 0.0}
+        )
+        st["calls"] += 1
+        st["total_s"] += seconds
+        shape = _last_trace_shape.get(name, "")
+        if traced:
+            st["traces"] += 1
+            events = _kernel_traces.setdefault(name, [])
+            events.append({"shape": shape, "wall_s": round(seconds, 6)})
+            del events[:-_KERNEL_TRACE_EVENTS]
+    from .metrics import global_metrics
+
+    global_metrics.measure(
+        f"nomad.kernel.{short}.compile" if traced
+        else f"nomad.kernel.{short}.execute",
+        seconds,
+    )
+    global _obs_tracer
+    if _obs_tracer is None:
+        from ..obs.trace import global_tracer
+
+        _obs_tracer = global_tracer
+    _obs_tracer.record_kernel(
+        short, seconds, traced=traced, shape=shape if traced else None
+    )
+
+
+def kernel_profile() -> dict:
+    """Per-kernel profile snapshot: call/trace counts, cumulative wall
+    time, the last shapes that triggered traces (the /v1/agent/trace
+    ``kernels`` section and the retrace post-mortem companion)."""
+    with _trace_lock:
+        out = {}
+        for name, st in _kernel_stats.items():
+            out[name] = {
+                "calls": st["calls"],
+                "traces": st["traces"],
+                "total_ms": round(st["total_s"] * 1000.0, 3),
+                "last_trace_shape": _last_trace_shape.get(name, ""),
+                "recent_traces": list(_kernel_traces.get(name, ())),
+            }
+        return out
+
+
+def reset_kernel_profile() -> None:
+    with _trace_lock:
+        _kernel_stats.clear()
+        _kernel_traces.clear()
+        _last_trace_shape.clear()
 
 
 def trace_counts() -> dict[str, int]:
@@ -78,9 +169,25 @@ def traced_jit(fn=None, *, trace_name=None, retrace_budget=None, **jit_kwargs):
     @functools.wraps(fn)
     def _counted(*args, **kwargs):
         record_trace(name)
+        sig = _shape_sig(args, kwargs)
+        with _trace_lock:
+            _last_trace_shape[name] = sig
         return fn(*args, **kwargs)
 
-    return jax.jit(_counted, **jit_kwargs)
+    jitted = jax.jit(_counted, **jit_kwargs)
+    short = name.rsplit(".", 1)[-1]
+
+    @functools.wraps(fn)
+    def _profiled(*args, **kwargs):
+        before = _trace_counts.get(name, 0)
+        t0 = time.perf_counter()
+        out = jitted(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        _record_kernel_call(name, short, dt, _trace_counts.get(name, 0) > before)
+        return out
+
+    _profiled.jitted = jitted  # escape hatch: the raw jax.jit object
+    return _profiled
 
 
 def probe_device_count(timeout_s: float = 90.0) -> int:

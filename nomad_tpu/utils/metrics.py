@@ -52,23 +52,30 @@ class Metrics:
         return sorted_buf[i]
 
     def snapshot(self) -> dict:
+        # copy under the lock, sort outside it: percentile recomputation
+        # over up to 8192 samples per key is O(n log n) per series, and
+        # holding the registry lock through it would stall every
+        # measure()/incr() on the worker hot path while /v1/metrics renders
         with self._lock:
-            samples = {}
-            for name, buf in self._samples.items():
-                s = sorted(buf)
-                samples[name] = {
-                    "count": len(buf),
-                    "mean_ms": (sum(buf) / len(buf)) * 1000 if buf else 0.0,
-                    "p50_ms": self._pct(s, 0.50) * 1000,
-                    "p95_ms": self._pct(s, 0.95) * 1000,
-                    "p99_ms": self._pct(s, 0.99) * 1000,
-                    "max_ms": s[-1] * 1000 if s else 0.0,
-                }
-            return {
-                "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
-                "samples": samples,
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            buffers = {name: list(buf) for name, buf in self._samples.items()}
+        samples = {}
+        for name, buf in buffers.items():
+            s = sorted(buf)
+            samples[name] = {
+                "count": len(buf),
+                "mean_ms": (sum(buf) / len(buf)) * 1000 if buf else 0.0,
+                "p50_ms": self._pct(s, 0.50) * 1000,
+                "p95_ms": self._pct(s, 0.95) * 1000,
+                "p99_ms": self._pct(s, 0.99) * 1000,
+                "max_ms": s[-1] * 1000 if s else 0.0,
             }
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "samples": samples,
+        }
 
     def reset(self) -> None:
         with self._lock:
@@ -88,9 +95,15 @@ def count_swallowed(component: str, exc: BaseException | None = None) -> None:
     ``except`` that deliberately eats an error in server/broker/state
     code calls this (or logs outright) — the NTA003 lint rule rejects
     handlers that do neither, so swallows stay visible on the metrics
-    surface instead of silently zeroing throughput."""
+    surface instead of silently zeroing throughput. Each swallow also
+    lands in the flight recorder's error ring (/v1/agent/trace)."""
     global_metrics.incr(f"{component}.swallowed_errors")
     _swallow_log.debug(
         "%s: swallowed %s: %s", component, type(exc).__name__ if exc else
         "error", exc, exc_info=exc is not None,
+    )
+    from ..obs.recorder import flight_recorder
+
+    flight_recorder.record_error(
+        component, repr(exc) if exc is not None else "error"
     )

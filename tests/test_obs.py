@@ -1,0 +1,418 @@
+"""nomad_tpu.obs: span/tracer API, cross-thread trace propagation across
+the worker → plan-queue → applier handoff, flight-recorder ring,
+/v1/agent/trace surface, kernel profiling hooks, and the tracing
+overhead guard.
+
+All tests here are CPU-only and ride tier-1.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.obs.recorder import (
+    FlightRecorder,
+    flight_recorder,
+    phase_breakdown,
+    render_trace,
+)
+from nomad_tpu.obs.trace import SpanContext, Tracer, global_tracer
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.utils import backend
+from nomad_tpu.utils.metrics import count_swallowed, global_metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    global_tracer.set_enabled(True)
+    global_tracer.reset()
+    flight_recorder.clear()
+    yield
+    global_tracer.set_enabled(True)
+    global_tracer.reset()
+    flight_recorder.clear()
+
+
+def span_by_name(trace, name):
+    matches = [s for s in trace["spans"] if s["name"] == name]
+    assert matches, f"no span named {name!r} in {trace['spans']}"
+    return matches[0]
+
+
+# -- Tracer unit tests ------------------------------------------------------
+
+
+class TestTracer:
+    def test_span_nesting_parents_via_thread_stack(self):
+        t = Tracer()
+        t.begin("e1")
+        with t.activate("e1"):
+            with t.span("outer") as outer:
+                with t.span("inner") as inner:
+                    assert inner.parent_id == outer.span_id
+        tr = t.finish("e1")
+        outer_d = span_by_name(tr, "outer")
+        assert outer_d["parent_id"] == tr["spans"][0]["span_id"]  # root
+
+    def test_begin_is_idempotent_and_merges_tags(self):
+        t = Tracer()
+        a = t.begin("e1", tags={"x": 1})
+        b = t.begin("e1", tags={"y": 2})
+        assert a is b
+        assert t.finish("e1")["tags"] == {"x": 1, "y": 2}
+        # second finish is a no-op, not a duplicate record
+        assert t.finish("e1") is None
+
+    def test_finish_hands_trace_to_recorder(self):
+        rec = FlightRecorder()
+        t = Tracer(recorder=rec)
+        t.begin("e1")
+        t.finish("e1", status="acked")
+        assert rec.get("e1")["status"] == "acked"
+
+    def test_ctx_handoff_across_threads(self):
+        """The worker → applier handoff: a SpanContext captured on one
+        thread parents spans opened on another."""
+        t = Tracer()
+        t.begin("e1")
+        got = {}
+
+        def applier(ctx):
+            with t.attach(ctx):
+                with t.span("plan_apply") as sp:
+                    got["parent"] = sp.parent_id
+
+        with t.activate("e1"):
+            with t.span("submit_plan") as submit:
+                ctx = t.current_ctx()
+                assert isinstance(ctx, SpanContext)
+                th = threading.Thread(target=applier, args=(ctx,))
+                th.start()
+                th.join()
+        tr = t.finish("e1")
+        assert got["parent"] == span_by_name(tr, "submit_plan")["span_id"]
+        assert submit.span_id == got["parent"]
+
+    def test_span_with_no_active_trace_yields_none(self):
+        t = Tracer()
+        with t.span("orphan") as sp:
+            assert sp is None
+
+    def test_late_span_after_finish_is_counted_dropped(self):
+        t = Tracer()
+        root = t.begin("e1")
+        t.finish("e1")
+        with t.span("late", parent=root) as sp:
+            assert sp is None
+        assert t.dropped_spans() == 1
+
+    def test_disabled_tracer_noops_but_timer_still_samples(self):
+        t = Tracer()
+        assert t.set_enabled(False) is True
+        assert t.begin("e1") is None
+        global_metrics.reset()
+        with t.span("x", timer="obs.test.disabled_timer") as sp:
+            assert sp is None
+        snap = global_metrics.snapshot()
+        assert "obs.test.disabled_timer" in snap["samples"]
+        assert t.active_count() == 0
+
+    def test_disabling_drops_inflight_traces(self):
+        t = Tracer()
+        t.begin("e1")
+        t.set_enabled(False)
+        assert t.active_count() == 0
+        assert t.finish("e1") is None
+
+    def test_span_error_status_and_reraise(self):
+        t = Tracer()
+        t.begin("e1")
+        with t.activate("e1"):
+            with pytest.raises(ValueError):
+                with t.span("boom"):
+                    raise ValueError("x")
+        tr = t.finish("e1")
+        assert span_by_name(tr, "boom")["status"] == "error"
+
+    def test_add_span_retroactive_defaults_to_root_parent(self):
+        t = Tracer()
+        t.begin("e1")
+        t.add_span("e1", "dequeue", 0.5, tags={"shared": False})
+        tr = t.finish("e1")
+        d = span_by_name(tr, "dequeue")
+        assert d["parent_id"] == tr["spans"][0]["span_id"]
+        assert d["duration_ms"] == pytest.approx(500.0)
+
+
+# -- FlightRecorder ---------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_evicts_oldest_first(self):
+        rec = FlightRecorder(capacity=3)
+        for i in range(4):
+            rec.record({"eval_id": f"e{i}", "spans": []})
+        assert len(rec) == 3
+        assert rec.get("e0") is None
+        assert [t["eval_id"] for t in rec.traces()] == ["e3", "e2", "e1"]
+
+    def test_rerecord_moves_to_newest(self):
+        rec = FlightRecorder(capacity=3)
+        for i in range(3):
+            rec.record({"eval_id": f"e{i}", "spans": []})
+        rec.record({"eval_id": "e0", "spans": [], "retry": True})
+        rec.record({"eval_id": "e3", "spans": []})
+        # e1 (now the oldest) was evicted, re-recorded e0 survived
+        assert rec.get("e1") is None
+        assert rec.get("e0")["retry"] is True
+
+    def test_error_ring_caps_and_reads_newest_first(self):
+        rec = FlightRecorder(error_capacity=2)
+        for i in range(3):
+            rec.record_error("comp", f"err-{i}", eval_id=f"e{i}")
+        errs = rec.errors()
+        assert [e["error"] for e in errs] == ["err-2", "err-1"]
+
+    def test_list_summarizes(self):
+        rec = FlightRecorder()
+        rec.record(
+            {
+                "eval_id": "e1",
+                "status": "acked",
+                "started_at": 1.0,
+                "duration_ms": 2.5,
+                "tags": {"job_id": "j"},
+                "spans": [{}, {}],
+            }
+        )
+        (s,) = rec.list()
+        assert s == {
+            "eval_id": "e1",
+            "status": "acked",
+            "started_at": 1.0,
+            "duration_ms": 2.5,
+            "spans": 2,
+            "tags": {"job_id": "j"},
+        }
+
+    def test_count_swallowed_lands_in_error_ring(self):
+        count_swallowed("obstest", ValueError("boom"))
+        errs = flight_recorder.errors()
+        assert errs and errs[0]["component"] == "obstest"
+        assert "boom" in errs[0]["error"]
+
+    def test_render_trace_indents_children(self):
+        t = Tracer()
+        t.begin("e1", tags={"job_id": "j1"})
+        with t.activate("e1"):
+            with t.span("invoke_scheduler"):
+                with t.span("kernel_score"):
+                    pass
+        out = render_trace(t.finish("e1", status="acked"))
+        lines = out.splitlines()
+        assert lines[0].startswith("eval e1  acked")
+        assert "job_id=j1" in lines[0]
+        assert lines[1].startswith("  invoke_scheduler")
+        assert lines[2].startswith("    kernel_score")
+
+    def test_phase_breakdown_excludes_root(self):
+        t = Tracer()
+        t.begin("e1")
+        t.add_span("e1", "snapshot", 0.010)
+        t.add_span("e1", "snapshot", 0.030)
+        bd = phase_breakdown([t.finish("e1")])
+        assert set(bd) == {"snapshot"}
+        assert bd["snapshot"]["count"] == 2
+        assert bd["snapshot"]["mean_ms"] == pytest.approx(20.0, abs=0.01)
+        assert bd["snapshot"]["max_ms"] == pytest.approx(30.0, abs=0.01)
+
+
+# -- kernel profiling hooks -------------------------------------------------
+
+
+class TestKernelProfile:
+    def test_traced_jit_records_compile_execute_and_shapes(self):
+        import jax.numpy as jnp
+
+        @backend.traced_jit
+        def _obs_toy_kernel(x):
+            return x * 2.0
+
+        backend.reset_kernel_profile()
+        global_metrics.reset()
+        _obs_toy_kernel(jnp.ones((4,)))  # trace 1
+        _obs_toy_kernel(jnp.ones((4,)))  # cached
+        _obs_toy_kernel(jnp.ones((8,)))  # trace 2 (new abstract shape)
+
+        (name,) = [
+            k for k in backend.kernel_profile() if "_obs_toy_kernel" in k
+        ]
+        prof = backend.kernel_profile()[name]
+        assert prof["calls"] == 3
+        assert prof["traces"] == 2
+        shapes = [e["shape"] for e in prof["recent_traces"]]
+        assert any("[4]" in s for s in shapes)
+        assert any("[8]" in s for s in shapes)
+        assert prof["last_trace_shape"] == shapes[-1]
+
+        samples = global_metrics.snapshot()["samples"]
+        assert samples["nomad.kernel._obs_toy_kernel.compile"]["count"] == 2
+        assert samples["nomad.kernel._obs_toy_kernel.execute"]["count"] == 1
+
+    def test_kernel_call_attaches_span_under_active_trace(self):
+        import jax.numpy as jnp
+
+        @backend.traced_jit
+        def _obs_span_kernel(x):
+            return x + 1.0
+
+        global_tracer.begin("ek1")
+        with global_tracer.activate("ek1"):
+            _obs_span_kernel(jnp.ones((2,)))
+        tr = global_tracer.finish("ek1")
+        k = span_by_name(tr, "kernel:_obs_span_kernel")
+        assert k["tags"]["traced"] is True
+        assert "float32[2]" in k["tags"]["shape"]
+        assert k["parent_id"] == tr["spans"][0]["span_id"]
+
+
+# -- end-to-end: trace of a real eval through the Server --------------------
+
+
+def _wait_trace(eval_id, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        tr = flight_recorder.get(eval_id)
+        if tr is not None:
+            return tr
+        time.sleep(0.02)
+    return None
+
+
+LIFECYCLE = {
+    "dequeue",
+    "snapshot",
+    "invoke_scheduler",
+    "submit_plan",
+    "plan_apply",
+    "wait_for_index",
+}
+
+
+class TestEndToEndTrace:
+    def test_eval_yields_full_lifecycle_trace(self):
+        server = Server(ServerConfig(num_workers=1))
+        server.establish_leadership()
+        try:
+            for _ in range(3):
+                server.register_node(mock.node())
+            ev = server.register_job(mock.job())
+            assert server.wait_for_evals(timeout=15)
+            tr = _wait_trace(ev.id)
+        finally:
+            server.shutdown()
+
+        assert tr is not None, "eval left no trace in the flight recorder"
+        assert tr["status"] == "acked"
+        names = {s["name"] for s in tr["spans"]}
+        assert LIFECYCLE <= names, f"missing {LIFECYCLE - names}"
+
+        # one root, every parent resolves inside the trace
+        ids = {s["span_id"] for s in tr["spans"]}
+        roots = [s for s in tr["spans"] if s["parent_id"] is None]
+        assert len(roots) == 1
+        assert all(
+            s["parent_id"] in ids for s in tr["spans"] if s["parent_id"]
+        )
+
+        # the cross-thread handoff: plan-queue wait + plan_apply parent
+        # under the worker's submit_plan span
+        submit = span_by_name(tr, "submit_plan")
+        assert span_by_name(tr, "plan_apply")["parent_id"] == submit["span_id"]
+        assert (
+            span_by_name(tr, "plan_queue.wait")["parent_id"]
+            == submit["span_id"]
+        )
+        assert span_by_name(tr, "dequeue")["tags"]["queue_wait_ms"] >= 0
+
+        # nothing leaked: no orphan actives, no dropped spans
+        assert global_tracer.active_count() == 0
+        assert global_tracer.dropped_spans() == 0
+
+    def test_http_trace_endpoints(self):
+        from nomad_tpu.api.client import APIException, NomadClient
+        from nomad_tpu.api.http import HTTPAgent
+
+        server = Server(ServerConfig(num_workers=1))
+        server.establish_leadership()
+        http = HTTPAgent(server, None, port=0)
+        http.start()
+        try:
+            c = NomadClient(http.address)
+            for _ in range(2):
+                server.register_node(mock.node())
+            ev = server.register_job(mock.job())
+            assert server.wait_for_evals(timeout=15)
+            assert _wait_trace(ev.id) is not None
+
+            idx = c._request("GET", "/v1/agent/trace")
+            assert ev.id in [t["eval_id"] for t in idx["traces"]]
+            assert "errors" in idx and "kernels" in idx
+
+            tr = c._request("GET", f"/v1/agent/trace/{ev.id}")
+            assert {s["name"] for s in tr["spans"]} >= LIFECYCLE
+
+            with pytest.raises(APIException):
+                c._request("GET", "/v1/agent/trace/no-such-eval")
+        finally:
+            http.stop()
+            server.shutdown()
+
+
+# -- overhead guard ---------------------------------------------------------
+
+
+def _run_workload(server, round_id, n_jobs=4):
+    jobs = []
+    for j in range(n_jobs):
+        job = mock.job()
+        job.id = f"ovh-{round_id}-{j}"
+        job.task_groups[0].count = 4
+        jobs.append(job)
+    t0 = time.perf_counter()
+    for job in jobs:
+        server.register_job(job)
+    assert server.wait_for_evals(timeout=60)
+    elapsed = time.perf_counter() - t0
+    for job in jobs:
+        server.deregister_job(job.namespace, job.id)
+    assert server.wait_for_evals(timeout=60)
+    return elapsed
+
+
+class TestTracingOverhead:
+    def test_enabled_within_5_percent_of_disabled(self):
+        """Tracing must be cheap enough to leave on: enabled e2e wall
+        time within 5% of disabled (plus absolute slack — these runs
+        are tens of milliseconds, where scheduler jitter dominates)."""
+        server = Server(ServerConfig(num_workers=1))
+        server.establish_leadership()
+        try:
+            for _ in range(4):
+                server.register_node(mock.node())
+            _run_workload(server, "warm")  # compile + warm every path
+            enabled, disabled = [], []
+            for i in range(3):
+                global_tracer.set_enabled(False)
+                disabled.append(_run_workload(server, f"off{i}"))
+                global_tracer.set_enabled(True)
+                enabled.append(_run_workload(server, f"on{i}"))
+        finally:
+            global_tracer.set_enabled(True)
+            server.shutdown()
+        assert min(enabled) <= min(disabled) * 1.05 + 0.5, (
+            f"tracing overhead too high: enabled={enabled} "
+            f"disabled={disabled}"
+        )

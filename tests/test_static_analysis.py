@@ -1,4 +1,4 @@
-"""nomad_tpu.analysis: lint rules (NTA001-005), baseline ratchet, CLI,
+"""nomad_tpu.analysis: lint rules (NTA001-006), baseline ratchet, CLI,
 runtime lock-graph race detector, and jit-retrace budget checker.
 
 Every rule gets a trigger + non-trigger fixture through the
@@ -25,6 +25,7 @@ from nomad_tpu.analysis.rules.determinism import WallClockInScoringPath
 from nomad_tpu.analysis.rules.hostsync import HostSyncInJitKernel
 from nomad_tpu.analysis.rules.lockfields import LockDiscipline
 from nomad_tpu.analysis.rules.planfreeze import PlanMutationAfterSubmit
+from nomad_tpu.analysis.rules.spans import SpanCoverage
 from nomad_tpu.analysis.rules.swallow import SilentExceptionSwallow
 from nomad_tpu.utils import backend
 from nomad_tpu.utils.metrics import count_swallowed, global_metrics
@@ -313,6 +314,51 @@ class TestNTA005:
         assert run(src, "nomad_tpu/state/s.py", LockDiscipline) == []
 
 
+# -- NTA006: eval-lifecycle timing via the span API ------------------------
+
+
+class TestNTA006:
+    def test_raw_timer_in_worker_triggers(self):
+        src = (
+            "def process(metrics, ev):\n"
+            "    with metrics.timer('nomad.worker.invoke_scheduler'):\n"
+            "        pass\n"
+        )
+        fs = run(src, "nomad_tpu/server/worker.py", SpanCoverage)
+        assert rule_ids(fs) == ["NTA006"]
+        assert fs[0].symbol == "process"
+
+    def test_span_with_timer_passthrough_is_clean(self):
+        src = (
+            "def process(tracer, ev):\n"
+            "    with tracer.span('invoke_scheduler',\n"
+            "                     timer='nomad.worker.invoke_scheduler'):\n"
+            "        pass\n"
+        )
+        assert run(src, "nomad_tpu/server/worker.py", SpanCoverage) == []
+
+    def test_out_of_scope_module_not_flagged(self):
+        src = (
+            "def collect(metrics):\n"
+            "    with metrics.timer('nomad.gc.pass'):\n"
+            "        pass\n"
+        )
+        assert run(src, "nomad_tpu/state/core_gc.py", SpanCoverage) == []
+
+    def test_allow_comment_waives(self):
+        src = (
+            "def process(metrics, ev):\n"
+            "    with metrics.timer('x'):  # nta: allow=NTA006\n"
+            "        pass\n"
+        )
+        assert (
+            lint.check_source(
+                src, "nomad_tpu/server/worker.py", rules=[SpanCoverage()]
+            )
+            == []
+        )
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -379,9 +425,9 @@ class TestBaselineRatchet:
             f.render() for f in new
         )
 
-    def test_registry_covers_all_five_rules(self):
+    def test_registry_covers_all_rules(self):
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
-            "NTA001", "NTA002", "NTA003", "NTA004", "NTA005",
+            "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
         ]
 
 
