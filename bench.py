@@ -304,7 +304,15 @@ def bench_end_to_end(
         snap = global_metrics.snapshot()
         plan = snap["samples"].get("nomad.plan.apply", {})
         invoke = snap["samples"].get("nomad.worker.invoke_scheduler", {})
+        verify_batch = snap["samples"].get("nomad.plan.verify_batch", {})
         counters = snap["counters"]
+        # commit-train coalescing: how many member plans each applier
+        # commit carried (plans_per_commit ≈ batch depth means the whole
+        # pass landed as ONE verify/apply instead of a per-eval train)
+        plan_commits = int(counters.get("nomad.plan.commits", 0))
+        committed_plans = int(counters.get("nomad.plan.committed_plans", 0))
+        merged_commits = int(counters.get("nomad.plan.merged_commits", 0))
+        merged_members = int(counters.get("nomad.plan.merged_members", 0))
         # per-eval counter, NOT the invoke_scheduler sample count: the
         # batched pass emits ONE timer sample per multi-eval batch
         evals = int(counters.get("nomad.worker.evals_processed", n_jobs))
@@ -369,6 +377,24 @@ def bench_end_to_end(
                 "conflict_rate": round(batch_conflicts / batch_total, 3)
                 if batch_total
                 else 0.0,
+            },
+            # the coalesced commit train (one merged verify/apply per
+            # batched pass): plans landed per applier commit, the merged
+            # applier's batch width, and the vectorized verify tail
+            "commit_train": {
+                "plan_commits": plan_commits,
+                "plans_per_commit": round(committed_plans / plan_commits, 2)
+                if plan_commits
+                else 0.0,
+                "merged_commits": merged_commits,
+                "applier_batch_size": round(
+                    merged_members / merged_commits, 2
+                )
+                if merged_commits
+                else 0.0,
+                "verify_batch_p95_ms": round(
+                    verify_batch.get("p95_ms", 0.0), 2
+                ),
             },
             "device_cache": {
                 "full_flattens": server.device_cache.full_flattens,

@@ -8,6 +8,7 @@ from .swallow import SilentExceptionSwallow  # noqa: E402
 from .planfreeze import PlanMutationAfterSubmit  # noqa: E402
 from .lockfields import LockDiscipline  # noqa: E402
 from .spans import SpanCoverage  # noqa: E402
+from .mergedsubmit import MergedSubmitDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -16,6 +17,7 @@ REGISTRY = [
     PlanMutationAfterSubmit,  # NTA004
     LockDiscipline,  # NTA005
     SpanCoverage,  # NTA006
+    MergedSubmitDiscipline,  # NTA007
 ]
 
 __all__ = ["REGISTRY"]

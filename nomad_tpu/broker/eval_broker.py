@@ -23,6 +23,12 @@ from ..structs.evaluation import EVAL_DELIVERY_LIMIT
 FAILED_QUEUE = "_failed"
 DEFAULT_NACK_DELAY = 5.0
 DEFAULT_INITIAL_NACK_DELAY = 1.0
+# redelivery deadline for dequeued-but-unacked evals: a worker that dies
+# mid-eval (crash, hung commit) would otherwise strand its evals — and,
+# through per-job serialization, every later eval of the same jobs —
+# forever. Sized well past the worker's longest internal wait (the 30 s
+# plan future timeout) so slow-but-alive workers don't double-deliver.
+DEFAULT_UNACK_TIMEOUT = 60.0
 
 
 class _PQ:
@@ -54,12 +60,16 @@ class EvalBroker:
         initial_nack_delay: float = DEFAULT_INITIAL_NACK_DELAY,
         delivery_limit: int = EVAL_DELIVERY_LIMIT,
         n_partitions: int = 1,
+        unack_timeout: Optional[float] = DEFAULT_UNACK_TIMEOUT,
     ):
         self._lock = threading.Condition()
         self.enabled = False
         self.nack_delay = nack_delay
         self.initial_nack_delay = initial_nack_delay
         self.delivery_limit = delivery_limit
+        # None disables the redelivery deadline (tests that hold evals
+        # outstanding across arbitrary debugger pauses)
+        self.unack_timeout = unack_timeout
         # Eval-stream partitioning for CONCURRENT batching workers: each
         # eval's job hashes onto one of n_partitions sub-queues, and a
         # batching worker dequeues only its own partition — two batched
@@ -70,8 +80,8 @@ class EvalBroker:
         self.n_partitions = max(1, n_partitions)
         # scheduler type (or "type#pN" when partitioned) → ready queue
         self._ready: dict[str, _PQ] = {}
-        # eval id → (eval, token, deadline) while unacked
-        self._unack: dict[str, tuple[Evaluation, str]] = {}
+        # eval id → (eval, token, redelivery deadline) while unacked
+        self._unack: dict[str, tuple[Evaluation, str, float]] = {}
         # (ns, job id) → deferred evals waiting for the in-flight one
         self._pending_by_job: dict[tuple[str, str], _PQ] = {}
         self._in_flight_jobs: set[tuple[str, str]] = set()
@@ -157,6 +167,24 @@ class EvalBroker:
             else:
                 wait = fire - now
                 break
+        # redelivery deadline sweep: evals whose dequeuing worker never
+        # acked or nacked within unack_timeout go back through the normal
+        # nack path (backoff redelivery, _failed past the delivery limit)
+        if self.unack_timeout is not None:
+            expired = [
+                eid
+                for eid, (_ev, _tok, deadline) in self._unack.items()
+                if deadline <= now
+            ]
+            for eid in expired:
+                ev, _tok, _deadline = self._unack.pop(eid)
+                self._queue_waits.pop(eid, None)
+                from ..utils.metrics import global_metrics
+
+                global_metrics.incr("nomad.broker.unack_timeouts")
+                self._redeliver_locked(ev)
+            for _ev, _tok, deadline in self._unack.values():
+                wait = min(wait, max(deadline - now, 0.001))
         return wait
 
     # -- dequeue -----------------------------------------------------------
@@ -230,7 +258,12 @@ class EvalBroker:
                 if best is not None:
                     ev = best.pop()
                     token = str(uuid.uuid4())
-                    self._unack[ev.id] = (ev, token)
+                    deadline = (
+                        time.time() + self.unack_timeout
+                        if self.unack_timeout is not None
+                        else float("inf")
+                    )
+                    self._unack[ev.id] = (ev, token, deadline)
                     self._in_flight_jobs.add((ev.namespace, ev.job_id))
                     self._delivery_count[ev.id] = (
                         self._delivery_count.get(ev.id, 0) + 1
@@ -275,7 +308,7 @@ class EvalBroker:
         entry = self._unack.get(eval_id)
         if entry is None:
             raise ValueError(f"eval {eval_id} not outstanding")
-        ev, tok = entry
+        ev, tok, _deadline = entry
         if tok != token:
             raise ValueError("token mismatch")
         return ev
@@ -314,23 +347,28 @@ class EvalBroker:
             ev = self._validate(eval_id, token)
             del self._unack[eval_id]
             self._queue_waits.pop(eval_id, None)
-            job_key = (ev.namespace, ev.job_id)
-            self._in_flight_jobs.discard(job_key)
-            count = self._delivery_count.get(ev.id, 0)
-            if count >= self.delivery_limit:
-                self._ready.setdefault(FAILED_QUEUE, _PQ()).push(ev)
-                # the job's gate is permanently released for this eval —
-                # deferred evals must not be stranded behind it
-                self._promote_pending_locked(job_key)
-            else:
-                delay = (
-                    self.initial_nack_delay if count <= 1 else self.nack_delay
-                )
-                heapq.heappush(
-                    self._delayed,
-                    (time.time() + delay, next(self._seq), ev),
-                )
+            self._redeliver_locked(ev)
             self._lock.notify_all()
+
+    def _redeliver_locked(self, ev: Evaluation) -> None:
+        """Shared tail of an explicit nack and an unack-deadline expiry:
+        release the job gate, then backoff-redeliver or fail out."""
+        job_key = (ev.namespace, ev.job_id)
+        self._in_flight_jobs.discard(job_key)
+        count = self._delivery_count.get(ev.id, 0)
+        if count >= self.delivery_limit:
+            self._ready.setdefault(FAILED_QUEUE, _PQ()).push(ev)
+            # the job's gate is permanently released for this eval —
+            # deferred evals must not be stranded behind it
+            self._promote_pending_locked(job_key)
+        else:
+            delay = (
+                self.initial_nack_delay if count <= 1 else self.nack_delay
+            )
+            heapq.heappush(
+                self._delayed,
+                (time.time() + delay, next(self._seq), ev),
+            )
 
     # -- introspection -----------------------------------------------------
     def outstanding(self, eval_id: str) -> bool:

@@ -17,16 +17,22 @@ vectorized host pass (and the touched-node count per plan is small).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
+
+import numpy as np
 
 from ..obs.trace import global_tracer as tracer
 from ..structs import (
     Allocation,
+    MergedPlan,
     NetworkIndex,
     Plan,
     PlanResult,
     allocs_fit,
 )
+from ..structs.resources import node_comparable_capacity
+from ..utils.metrics import global_metrics as metrics
 
 
 def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
@@ -57,16 +63,25 @@ def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
     # node carries a network (the common case; building a NetworkIndex
     # per touched node was a measurable slice of the applier's verify)
     if any(getattr(a, "allocated_networks", None) for a in proposed):
-        idx = NetworkIndex(node)
-        if not idx.add_allocs(a for a in proposed if a.id not in new_ids):
-            return False, "port collision in existing allocations"
-        for a in new_allocs:
-            for net in a.allocated_networks:
-                for p in net.reserved_ports + net.dynamic_ports:
-                    if p.value in idx.used_ports:
-                        return False, f"port {p.value} already in use"
-            for net in a.allocated_networks:
-                idx.add_reserved_network(net)
+        return _node_ports_ok(node, proposed, new_allocs)
+    return True, ""
+
+
+def _node_ports_ok(node, proposed, new_allocs) -> tuple[bool, str]:
+    """Port-collision re-check for one node: existing reservations index
+    first, then each new alloc's ports against it (evaluateNodePlan's
+    NetworkIndex walk)."""
+    new_ids = {a.id for a in new_allocs}
+    idx = NetworkIndex(node)
+    if not idx.add_allocs(a for a in proposed if a.id not in new_ids):
+        return False, "port collision in existing allocations"
+    for a in new_allocs:
+        for net in a.allocated_networks:
+            for p in net.reserved_ports + net.dynamic_ports:
+                if p.value in idx.used_ports:
+                    return False, f"port {p.value} already in use"
+        for net in a.allocated_networks:
+            idx.add_reserved_network(net)
     return True, ""
 
 
@@ -173,6 +188,170 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     return result
 
 
+def _merged_touched_nodes(plans) -> dict[str, list[int]]:
+    """node id → ordered member ordinals touching it (a member appears
+    once even when it touches the node in several buckets)."""
+    touched: dict[str, list[int]] = {}
+    for i, mp in enumerate(plans):
+        for bucket in (mp.node_allocation, mp.node_update, mp.node_preemptions):
+            for node_id in bucket:
+                members = touched.setdefault(node_id, [])
+                if not members or members[-1] != i:
+                    members.append(i)
+    return touched
+
+
+def _fast_path_slack(snapshot, node_id, member_plans):
+    """Vectorized-verify candidacy for one node: when every touching
+    member only ADDS networkless, deviceless, claim-free allocations, the
+    whole union check reduces to ``free - sum(asks) >= 0`` per dimension.
+    Returns that slack vector, or None to route the node to the exact
+    per-member walk (which reproduces evaluate_node_plan bit for bit)."""
+    node = snapshot.node_by_id(node_id)
+    if node is None or node.terminal_status():
+        return None
+    new_allocs = []
+    for mp in member_plans:
+        if node_id in mp.node_update or node_id in mp.node_preemptions:
+            return None
+        new_allocs.extend(mp.node_allocation.get(node_id, ()))
+    existing = snapshot.allocs_by_node(node_id)
+    existing_ids = {a.id for a in existing}
+    for a in new_allocs:
+        if (
+            a.id in existing_ids  # in-place update: replacement math
+            or a.allocated_networks  # needs the NetworkIndex re-check
+            or a.allocated_devices  # needs device-pool accounting
+            or a.job is not None  # un-normalized: CSI/device asks possible
+        ):
+            return None
+    free = node_comparable_capacity(node).to_vector()
+    for a in existing:
+        if a.terminal_status():
+            continue
+        if a.allocated_networks or a.allocated_devices or a.job is not None:
+            return None
+        free = free - a.comparable_resources().to_vector()
+    for a in new_allocs:
+        free = free - a.comparable_resources().to_vector()
+    return free
+
+
+def _evaluate_node_members(
+    snapshot, node_id: str, ordered, results, claimed
+) -> None:
+    """Exact member-order admission for one node shared by several member
+    plans: each member is checked against existing allocs PLUS everything
+    earlier members already got admitted, so two members of one merged
+    commit can never jointly overcommit a node. A failing member gets the
+    node in its ``rejected_nodes`` (stops still commit — they only free
+    capacity); siblings are unaffected. ``ordered`` is [(ordinal,
+    member_plan)] in batch order; ``results`` is indexed by ordinal."""
+    node = snapshot.node_by_id(node_id)
+    node_ok = node is not None and not node.terminal_status()
+    base = list(snapshot.allocs_by_node(node_id)) if node_ok else []
+    for ordinal, mp in ordered:
+        result = results[ordinal]
+        stops = mp.node_update.get(node_id, ())
+        preempts = mp.node_preemptions.get(node_id, ())
+        new_allocs = mp.node_allocation.get(node_id, ())
+        if not new_allocs:
+            # freeing-only member: always commits (matches evaluate_plan's
+            # no-placement branch)
+            if stops:
+                result.node_update[node_id] = list(stops)
+            if preempts:
+                result.node_preemptions[node_id] = list(preempts)
+            removed = {a.id for a in stops} | {a.id for a in preempts}
+            if removed:
+                base = [a for a in base if a.id not in removed]
+            continue
+        ok = node_ok
+        proposed: list = []
+        if ok:
+            removed = {a.id for a in stops} | {a.id for a in preempts}
+            new_ids = {a.id for a in new_allocs}
+            proposed = [
+                a for a in base
+                if a.id not in removed and a.id not in new_ids
+            ]
+            proposed.extend(new_allocs)
+            ok, _dim, _used = allocs_fit(node, proposed, check_devices=True)
+        if ok and any(
+            getattr(a, "allocated_networks", None) for a in proposed
+        ):
+            ok, _reason = _node_ports_ok(node, proposed, new_allocs)
+        if ok and not _csi_claims_ok(snapshot, new_allocs, claimed):
+            ok = False
+        if not ok:
+            result.rejected_nodes.append(node_id)
+            # stops still commit — the single-plan partial-commit rule
+            if stops:
+                result.node_update[node_id] = list(stops)
+                stop_ids = {a.id for a in stops}
+                base = [a for a in base if a.id not in stop_ids]
+            continue
+        if stops:
+            result.node_update[node_id] = list(stops)
+        if preempts:
+            result.node_preemptions[node_id] = list(preempts)
+        result.node_allocation[node_id] = list(new_allocs)
+        base = proposed
+
+
+def evaluate_merged_plan(snapshot, plans) -> list[PlanResult]:
+    """Verify a whole batched pass's member plans in ONE union-of-nodes
+    walk instead of N sequential per-plan walks, committing partially per
+    MEMBER: a node whose union of asks still fits admits every member in
+    one vectorized check; a node that fails (or needs ports / devices /
+    CSI / eviction math) drops to the exact member-order walk, where only
+    the members that no longer fit are rejected. Each rejected member
+    gets its own ``refresh_index``; siblings commit untouched."""
+    results = [PlanResult(alloc_index=0) for _ in plans]
+    touched = _merged_touched_nodes(plans)
+    slow_nodes: list[str] = []
+    fast_ids: list[str] = []
+    fast_rows: list = []
+    for node_id in sorted(touched):
+        slack = _fast_path_slack(
+            snapshot, node_id, [plans[i] for i in touched[node_id]]
+        )
+        if slack is None:
+            slow_nodes.append(node_id)
+        else:
+            fast_ids.append(node_id)
+            fast_rows.append(slack)
+    if fast_ids:
+        fits = (np.stack(fast_rows) >= 0).all(axis=1)
+        for node_id, node_fits in zip(fast_ids, fits):
+            if node_fits:
+                for i in touched[node_id]:
+                    allocs = plans[i].node_allocation.get(node_id)
+                    if allocs:
+                        results[i].node_allocation[node_id] = list(allocs)
+            else:
+                slow_nodes.append(node_id)
+    claimed: dict[str, tuple[int, int]] = {}  # vid → (readers, writers)
+    for node_id in sorted(slow_nodes):
+        _evaluate_node_members(
+            snapshot,
+            node_id,
+            [(i, plans[i]) for i in touched[node_id]],
+            results,
+            claimed,
+        )
+    refresh = getattr(snapshot, "latest_index", 0) or getattr(
+        snapshot, "index", 0
+    )
+    for i, mp in enumerate(plans):
+        res = results[i]
+        res.deployment = mp.deployment
+        res.deployment_updates = list(mp.deployment_updates)
+        if res.rejected_nodes:
+            res.refresh_index = refresh
+    return results
+
+
 def preemption_evals(store, result: PlanResult) -> list:
     """One follow-up evaluation per job that lost allocations to
     preemption, so victim jobs replace their capacity (the reference
@@ -211,10 +390,12 @@ class PlanApplier:
     ``on_evals_created`` (if set) receives preemption follow-up evals for
     broker enqueue."""
 
-    def __init__(self, store, on_evals_created=None, commit=None):
+    def __init__(self, store, on_evals_created=None, commit=None,
+                 commit_merged=None):
         self.store = store
         self.on_evals_created = on_evals_created
         self.commit = commit
+        self.commit_merged = commit_merged
         self._lock = threading.Lock()
 
     def apply(self, plan: Plan) -> PlanResult:
@@ -244,6 +425,9 @@ class PlanApplier:
                             self.store.upsert_evals(
                                 self.store.latest_index + 1, evals
                             )
+                # commit-train accounting: one FSM apply, one plan landed
+                metrics.incr("nomad.plan.commits")
+                metrics.incr("nomad.plan.committed_plans")
                 result.alloc_index = index
                 if evals and self.on_evals_created is not None:
                     # re-read post-commit: a consensus FSM applies COPIES,
@@ -254,3 +438,74 @@ class PlanApplier:
             if result.rejected_nodes:
                 result.refresh_index = self.store.latest_index
             return result
+
+    def apply_merged(self, mplan: MergedPlan) -> tuple[list[PlanResult], dict]:
+        """Verify + commit one merged batch under the serialized applier
+        lock: one union verify pass, one FSM/Raft entry, one store index
+        bump — per-member attribution preserved in the returned results.
+        Returns (results, phase timings in seconds); the apply loop
+        records the timings as shared spans into every member's trace."""
+        t_apply = time.perf_counter()
+        with self._lock:
+            t0 = time.perf_counter()
+            results = evaluate_merged_plan(self.store, mplan.plans)
+            evaluate_s = time.perf_counter() - t0
+            metrics.measure("nomad.plan.evaluate", evaluate_s)
+            # merged-only sample so the bench can report the batched
+            # verify latency separately from single-plan evaluates
+            metrics.measure("nomad.plan.verify_batch", evaluate_s)
+            commit_members = [
+                (mp.eval_id, res)
+                for mp, res in zip(mplan.plans, results)
+                if not res.is_no_op() or res.deployment is not None
+            ]
+            evals: list = []
+            for _eid, res in commit_members:
+                if res.node_preemptions:
+                    evals.extend(preemption_evals(self.store, res))
+            t0 = time.perf_counter()
+            if commit_members:
+                committed = [res for _eid, res in commit_members]
+                eval_ids = [eid for eid, _res in commit_members]
+                if self.commit_merged is not None:
+                    index = self.commit_merged(committed, eval_ids, evals)
+                elif self.commit is not None:
+                    # merged callback not wired: stay correct with
+                    # per-member commits (evals ride the first one)
+                    index = 0
+                    for i, (eid, res) in enumerate(commit_members):
+                        index = self.commit(
+                            res, eid, evals if i == 0 else []
+                        )
+                else:
+                    index = self.store.latest_index + 1
+                    self.store.upsert_merged_plan_results(index, committed)
+                    if evals:
+                        self.store.upsert_evals(
+                            self.store.latest_index + 1, evals
+                        )
+                metrics.incr("nomad.plan.commits")
+                metrics.incr(
+                    "nomad.plan.committed_plans", len(commit_members)
+                )
+                metrics.incr("nomad.plan.merged_commits")
+                metrics.incr(
+                    "nomad.plan.merged_members", len(commit_members)
+                )
+                for _eid, res in commit_members:
+                    res.alloc_index = index
+                if evals and self.on_evals_created is not None:
+                    self.on_evals_created([
+                        self.store.eval_by_id(e.id) or e for e in evals
+                    ])
+            commit_s = time.perf_counter() - t0
+            for res in results:
+                if res.rejected_nodes:
+                    res.refresh_index = self.store.latest_index
+            apply_s = time.perf_counter() - t_apply
+            metrics.measure("nomad.plan.apply", apply_s)
+            return results, {
+                "apply_s": apply_s,
+                "evaluate_s": evaluate_s,
+                "commit_s": commit_s,
+            }

@@ -14,15 +14,18 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import logging
 import threading
 import time
 from concurrent.futures import Future
 from typing import Optional
 
 from ..obs.trace import global_tracer as tracer
-from ..structs import Plan, PlanResult
+from ..structs import MergedPlan, Plan, PlanResult
 from ..utils.metrics import global_metrics as metrics
 from .plan_apply import PlanApplier
+
+log = logging.getLogger(__name__)
 
 
 class PendingPlan:
@@ -35,6 +38,28 @@ class PendingPlan:
         # applier thread parents its spans into the right eval trace
         self.trace_ctx = trace_ctx
         self.enqueued_at = time.perf_counter()
+
+    def cancel(self) -> None:
+        self.future.cancel()
+
+
+class PendingMergedPlan:
+    """One queue entry for a whole batched pass: B member plans, B result
+    futures — the coalesced commit unit the merged-apply path consumes."""
+
+    __slots__ = ("mplan", "futures", "trace_ctxs", "enqueued_at")
+
+    def __init__(self, mplan: MergedPlan, trace_ctxs=None):
+        self.mplan = mplan
+        self.futures: list[Future] = [Future() for _ in mplan.plans]
+        # one span context per member, so the applier thread records the
+        # shared merged-apply phases into every member's trace
+        self.trace_ctxs = list(trace_ctxs or [None] * len(mplan.plans))
+        self.enqueued_at = time.perf_counter()
+
+    def cancel(self) -> None:
+        for f in self.futures:
+            f.cancel()
 
 
 class PlanQueue:
@@ -49,7 +74,7 @@ class PlanQueue:
             self.enabled = enabled
             if not enabled:
                 for _, _, pending in self._heap:
-                    pending.future.cancel()
+                    pending.cancel()
                 self._heap.clear()
             self._lock.notify_all()
 
@@ -64,6 +89,28 @@ class PlanQueue:
             metrics.set_gauge("nomad.plan.queue_depth", len(self._heap))
             self._lock.notify_all()
             return pending.future
+
+    def enqueue_merged(
+        self, mplan: MergedPlan, trace_ctxs=None
+    ) -> list[Future]:
+        """Submit a whole batched pass as ONE pending entry; returns one
+        result future per member plan, resolved together when the merged
+        apply lands."""
+        with self._lock:
+            if not self.enabled:
+                futures: list[Future] = []
+                for _ in mplan.plans:
+                    f: Future = Future()
+                    f.set_exception(RuntimeError("plan queue is disabled"))
+                    futures.append(f)
+                return futures
+            pending = PendingMergedPlan(mplan, trace_ctxs=trace_ctxs)
+            heapq.heappush(
+                self._heap, (-mplan.priority, next(self._c), pending)
+            )
+            metrics.set_gauge("nomad.plan.queue_depth", len(self._heap))
+            self._lock.notify_all()
+            return pending.futures
 
     def pop(self, timeout: float = 1.0) -> Optional[PendingPlan]:
         with self._lock:
@@ -82,9 +129,10 @@ class PlanApplyLoop:
     """The leader's serialized applier thread (plan_apply.go:71-178)."""
 
     def __init__(self, store, queue: PlanQueue, on_evals_created=None,
-                 commit=None):
+                 commit=None, commit_merged=None):
         self.applier = PlanApplier(
-            store, on_evals_created=on_evals_created, commit=commit
+            store, on_evals_created=on_evals_created, commit=commit,
+            commit_merged=commit_merged,
         )
         self.queue = queue
         self._stop = threading.Event()
@@ -107,6 +155,9 @@ class PlanApplyLoop:
             pending = self.queue.pop(timeout=0.2)
             if pending is None:
                 continue
+            if isinstance(pending, PendingMergedPlan):
+                self._apply_merged(pending)
+                continue
             ctx = pending.trace_ctx
             if ctx is not None:
                 tracer.add_span(
@@ -123,3 +174,47 @@ class PlanApplyLoop:
                 pending.future.set_result(result)
             except Exception as e:  # noqa: BLE001 — propagate to waiter
                 pending.future.set_exception(e)
+
+    def _apply_merged(self, pending: PendingMergedPlan) -> None:
+        """Apply one merged batch and resolve every member future; the
+        shared queue-wait and apply phases are recorded into each
+        member's trace (the batch-wide ``shared`` span convention)."""
+        wait_s = time.perf_counter() - pending.enqueued_at
+        mplan = pending.mplan
+        try:
+            results, timings = self.applier.apply_merged(mplan)
+        except Exception as e:  # noqa: BLE001 — propagate to waiters
+            log.exception("merged plan apply failed (%d members)",
+                          len(mplan.plans))
+            for f in pending.futures:
+                if not f.done():
+                    f.set_exception(e)
+            return
+        n = len(mplan.plans)
+        for mp, res, fut, ctx in zip(
+            mplan.plans, results, pending.futures, pending.trace_ctxs
+        ):
+            if ctx is not None:
+                eid = mp.eval_id
+                tracer.add_span(
+                    eid, "plan_queue.wait", wait_s,
+                    parent=ctx, tags={"shared": True},
+                )
+                sp = tracer.add_span(
+                    eid, "plan_apply", timings["apply_s"], parent=ctx,
+                    tags={
+                        "shared": True,
+                        "members": n,
+                        "rejected_nodes": len(res.rejected_nodes),
+                    },
+                )
+                if sp is not None:
+                    tracer.add_span(
+                        eid, "plan_apply.evaluate", timings["evaluate_s"],
+                        parent=sp, tags={"shared": True},
+                    )
+                    tracer.add_span(
+                        eid, "plan_apply.commit", timings["commit_s"],
+                        parent=sp, tags={"shared": True},
+                    )
+            fut.set_result(res)

@@ -236,12 +236,42 @@ class GenericScheduler:
         committed, eval finalized); False when the caller must fall back
         to the individual retry path on a fresh scheduler (partial
         commit against the optimistic shared snapshot)."""
+        plan = self.build_batch_plan(results)
+        if plan is None:
+            return True
+        result, new_snap = self.planner.submit_plan(plan)
+        return self.complete_merged_attempt(result, new_snapshot=new_snap)
+
+    def build_batch_plan(self, results) -> Optional[Plan]:
+        """Phase B1 of the coalesced commit path: consume this eval's
+        slice of the combined kernel results and hand back the plan for
+        the worker to merge into ONE batch submit. Creates any followup
+        evals eagerly (their ids are referenced by in-plan allocs, so
+        they must commit before the plan does). Returns None when there
+        is nothing to submit — the eval is finalized in place."""
         ct, tg_order = self._batch_ctx
         self._finish_placements(ct, tg_order, results)
         self._adjust_queued()
-        done, _retry = self._submit_attempt()
-        if not done:
+        if self.plan.is_no_op() and not self.followup_evals:
+            self._finished = True
+            self._finalize()
+            return None
+        for f in self.followup_evals:
+            self.planner.create_eval(f)
+        return self.plan
+
+    def complete_merged_attempt(self, result, new_snapshot=None) -> bool:
+        """Phase B2: consume this member's PlanResult from the merged
+        apply. Full commit → finalize, True. Partial commit (this member
+        went stale under the shared optimistic snapshot) → False: the
+        caller retries the eval individually on fresh state; batch
+        siblings are unaffected."""
+        if new_snapshot is not None:
+            self.snapshot = new_snapshot
+        full, _expected, _actual = result.full_commit(self.plan)
+        if not full:
             return False
+        self._finished = True
         self._finalize()
         return True
 

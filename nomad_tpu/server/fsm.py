@@ -56,6 +56,9 @@ class MsgType(IntEnum):
                                   # raft layer consumes it (autopilot
                                   # dead-server cleanup, operator raft
                                   # remove-peer); no state-store effect
+    MERGED_PLAN_RESULT = 29       # {results, eval_ids, evals} — one
+                                  # batched pass's member PlanResults as
+                                  # a single log entry / store txn
 
 
 class FSM:
@@ -185,6 +188,12 @@ def _apply_plan_result(fsm, store, index, p):
         store.upsert_evals(index, p["evals"])
 
 
+def _apply_merged_plan_result(fsm, store, index, p):
+    store.upsert_merged_plan_results(index, p["results"])
+    if p.get("evals"):  # preemption follow-ups ride the same commit
+        store.upsert_evals(index, p["evals"])
+
+
 def _apply_deployment_status(fsm, store, index, p):
     store.update_deployment_status(
         index, p["deployment_id"], p["status"], p.get("description", "")
@@ -295,4 +304,5 @@ _APPLIERS = {
     # membership change rides the log for ordering/durability but mutates
     # raft config, not the store (RaftNode._applier intercepts it)
     MsgType.RAFT_REMOVE_PEER: _apply_noop,
+    MsgType.MERGED_PLAN_RESULT: _apply_merged_plan_result,
 }

@@ -81,6 +81,7 @@ class Server:
             self.store, self.plan_queue,
             on_evals_created=self.eval_broker.enqueue_all,
             commit=self._commit_plan_result,
+            commit_merged=self._commit_merged_plan_result,
         )
         self.workers: list[Worker] = []
         # resident device tensors shared by all workers, refreshed
@@ -222,6 +223,15 @@ class Server:
         index, _ = self.raft_apply(
             self._msg.PLAN_RESULT,
             {"result": result, "eval_id": eval_id, "evals": evals},
+        )
+        return index
+
+    def _commit_merged_plan_result(self, results, eval_ids, evals) -> int:
+        """One batched pass's member results land as ONE log entry — the
+        merged-commit analog of _commit_plan_result."""
+        index, _ = self.raft_apply(
+            self._msg.MERGED_PLAN_RESULT,
+            {"results": results, "eval_ids": eval_ids, "evals": evals},
         )
         return index
 

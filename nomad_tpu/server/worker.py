@@ -33,7 +33,7 @@ import numpy as np
 
 from ..obs.trace import global_tracer as tracer
 from ..scheduler import new_scheduler
-from ..structs import Evaluation, Plan
+from ..structs import Evaluation, MergedPlan, Plan
 from ..utils.metrics import count_swallowed
 from ..utils.metrics import global_metrics as metrics
 
@@ -74,6 +74,26 @@ SCHEDULER_TYPES = ["service", "batch", "system", "sysbatch", "_core"]
 EVAL_BATCH_SIZE = 16
 
 
+class _EvalBuffer:
+    """Deferred eval writes for one batch commit. Every member's
+    finalize-time status update (and followup/blocked eval creates)
+    coalesces into ONE raft apply per flush instead of one per eval —
+    the eval-side analog of the merged plan commit."""
+
+    def __init__(self, server):
+        self._server = server
+        self.updates: list[Evaluation] = []
+        self.creates: list[Evaluation] = []
+
+    def flush(self) -> None:
+        creates, self.creates = self.creates, []
+        if creates:
+            self._server.apply_eval_create(creates)
+        updates, self.updates = self.updates, []
+        if updates:
+            self._server.apply_eval_update(updates)
+
+
 class _TokenPlanner:
     """Planner bound to ONE eval's broker token. Batch completion runs on
     the commit thread concurrently with the next pass's prepare, so the
@@ -83,6 +103,9 @@ class _TokenPlanner:
     def __init__(self, worker: "Worker", token: str):
         self._worker = worker
         self.token = token
+        # when the commit thread sets this, eval writes buffer for a
+        # batch-wide flush instead of raft-applying one at a time
+        self.buffer: Optional[_EvalBuffer] = None
 
     def submit_plan(self, plan: Plan):
         plan.eval_token = self.token
@@ -108,9 +131,15 @@ class _TokenPlanner:
         return result, new_snapshot
 
     def update_eval(self, ev: Evaluation) -> None:
+        if self.buffer is not None:
+            self.buffer.updates.append(ev)
+            return
         self._worker.server.apply_eval_update([ev])
 
     def create_eval(self, ev: Evaluation) -> None:
+        if self.buffer is not None:
+            self.buffer.creates.append(ev)
+            return
         self._worker.server.apply_eval_create([ev])
 
     def reblock_eval(self, ev: Evaluation) -> None:
@@ -458,52 +487,146 @@ class Worker:
         finally:
             self.server.placement_overlay.commit_finished()
 
+    def _nack_member(self, ev, token, e, what: str) -> None:
+        log.exception("worker %d: %s %s", self.id, what, ev.id)
+        count_swallowed("worker", e)
+        try:
+            self.server.eval_broker.nack(ev.id, token)
+        except ValueError as e2:
+            count_swallowed("worker", e2)
+        self._bump("nacked", "processed")
+        metrics.incr("nomad.worker.evals_processed")
+        tracer.finish(ev.id, status="nacked", error=repr(e))
+
     def _commit_batch_inner(
         self, prepared, all_asks, results, lane_ok, singles
     ) -> None:
+        """Coalesced commit: build every member's plan from its result
+        slice, then submit the WHOLE pass as one MergedPlan — one plan
+        queue entry, one vectorized applier verify, one raft apply — and
+        resolve each member from its own result future. A stale member
+        falls back to the individual path without failing its siblings."""
+        server = self.server
+        buf = _EvalBuffer(server)
+        members: list[tuple] = []  # (ev, token, sched, member plan)
+        done: list[tuple] = []  # acked after the status flush below
         try:
+            # 1. build: turn each member's lane slice into a plan. A lane
+            # conflict with no usable overflow candidate drops the member
+            # to the individual path before any submit.
             off = 0
             for ev, token, sched, n in prepared:
                 span = results[off : off + n]
                 span_ok = all(lane_ok[off : off + n])
                 off += n
                 if not span_ok:
-                    # a conflicted placement had no usable overflow
-                    # candidate
                     metrics.incr("nomad.worker.batch_conflict_fallbacks")
                     metrics.incr("nomad.worker.batch_repair_fallbacks")
                     singles.append((ev, token))
                     continue
+                sched.planner.buffer = buf
                 try:
                     # adopt this eval's trace on the commit thread so the
-                    # submit_plan → plan_apply spans parent into it
+                    # spans recorded below parent into it
                     with tracer.activate(ev.id):
-                        completed = sched.complete_batch_attempt(span)
+                        member = sched.build_batch_plan(span)
+                except Exception as e:  # nta: allow=NTA003 — _nack_member logs+counts
+                    self._nack_member(ev, token, e, "batch build")
+                    continue
+                if member is None:
+                    # no-op eval: finalized already (status buffered)
+                    done.append((ev, token))
+                    metrics.incr("nomad.worker.batch_evals_completed")
+                else:
+                    members.append((ev, token, sched, member))
+
+            # 2. followup evals must exist BEFORE the plans that reference
+            # them commit; one raft apply covers the whole batch's creates
+            buf.flush()
+
+            # 3. submit: ONE merged entry for the whole pass
+            mresults: list = [None] * len(members)
+            if members:
+                ctxs = []
+                for ev, token, _sched, member in members:
+                    member.eval_token = token
+                    member.normalize()
+                    with tracer.activate(ev.id):
+                        ctxs.append(tracer.current_ctx())
+                t0 = time.perf_counter()
+                futures = server.plan_queue.enqueue_merged(
+                    MergedPlan(plans=[m[3] for m in members]),
+                    trace_ctxs=ctxs,
+                )
+                for i, (ev, token, _sched, _member) in enumerate(members):
+                    try:
+                        mresults[i] = futures[i].result(timeout=30)
+                    except Exception as e:  # nta: allow=NTA003 — _nack_member logs+counts
+                        self._nack_member(ev, token, e, "merged submit")
+                submit_s = time.perf_counter() - t0
+                metrics.measure("nomad.worker.submit_plan", submit_s)
+                for i, (ev, _t, _s, _m) in enumerate(members):
+                    if mresults[i] is None:
+                        continue
+                    tracer.add_span(
+                        ev.id, "submit_plan", submit_s,
+                        tags={
+                            "shared": True,
+                            "rejected_nodes": len(mresults[i].rejected_nodes),
+                        },
+                    )
+
+                # 4. one shared refresh barrier for every partially
+                # committed member (each previously waited on its own)
+                refresh = max(
+                    (r.refresh_index for r in mresults if r is not None),
+                    default=0,
+                )
+                if refresh:
+                    t0 = time.perf_counter()
+                    server.store.wait_for_index(refresh, timeout=5.0)
+                    refresh_s = time.perf_counter() - t0
+                    for i, (ev, _t, _s, _m) in enumerate(members):
+                        if mresults[i] is not None and mresults[i].refresh_index:
+                            tracer.add_span(
+                                ev.id, "refresh_snapshot", refresh_s,
+                                tags={"shared": True, "refresh_index": refresh},
+                            )
+
+                # 5. complete: full commits finalize (status buffered);
+                # stale members retry individually on fresh state (the
+                # trace stays open; _run_one below appends the retry)
+                for i, (ev, token, sched, _member) in enumerate(members):
+                    if mresults[i] is None:
+                        continue  # nacked above
+                    try:
+                        with tracer.activate(ev.id):
+                            completed = sched.complete_merged_attempt(
+                                mresults[i]
+                            )
+                    except Exception as e:  # nta: allow=NTA003 — _nack_member logs+counts
+                        self._nack_member(ev, token, e, "batch complete")
+                        continue
                     if completed:
-                        self.server.eval_broker.ack(ev.id, token)
-                        self._bump("acked", "processed")
+                        done.append((ev, token))
                         metrics.incr("nomad.worker.batch_evals_completed")
-                        metrics.incr("nomad.worker.evals_processed")
-                        tracer.finish(ev.id, status="acked")
                     else:
-                        # optimistic conflict: re-run individually on
-                        # fresh state (the trace stays open; _run_one
-                        # below appends the retry attempt and finishes it)
                         metrics.incr("nomad.worker.batch_conflict_fallbacks")
                         metrics.incr("nomad.worker.batch_commit_fallbacks")
                         singles.append((ev, token))
-                except Exception as e:
-                    log.exception(
-                        "worker %d: batch complete %s", self.id, ev.id
-                    )
+
+            # 6. land every member's finalize-time status (and blocked
+            # eval creates) in one raft apply, then ack — status must be
+            # durable before the ack releases the per-job gate
+            buf.flush()
+            for ev, token in done:
+                try:
+                    server.eval_broker.ack(ev.id, token)
+                except ValueError as e:
                     count_swallowed("worker", e)
-                    try:
-                        self.server.eval_broker.nack(ev.id, token)
-                    except ValueError as e2:
-                        count_swallowed("worker", e2)
-                    self._bump("nacked", "processed")
-                    metrics.incr("nomad.worker.evals_processed")
-                    tracer.finish(ev.id, status="nacked", error=repr(e))
+                self._bump("acked", "processed")
+                metrics.incr("nomad.worker.evals_processed")
+                tracer.finish(ev.id, status="acked")
 
             for ev, token in singles:
                 metrics.incr("nomad.worker.batch_single_fallbacks")
@@ -525,6 +648,15 @@ class Worker:
                 tracer.finish(ev.id, status="nacked", error=repr(e))
 
     def process_eval(self, ev: Evaluation, planner=None) -> None:
+        # solo evals score against the shared overlay too (an overlay-
+        # blind pass would seed the very conflicts it predicts), so they
+        # must also retire its epoch before snapshotting — a long solo-
+        # only stretch otherwise accumulates every past ask against a
+        # frozen base until placements fail on a near-empty cluster.
+        # Safe from the commit thread's singles fallback: the commit
+        # marker is still held there, so maybe_reset() is a no-op.
+        if self.server.placement_overlay.maybe_reset():
+            metrics.incr("nomad.worker.pipeline_epoch_resets")
         # raft catch-up barrier (worker.go:536-549)
         with tracer.span(
             "wait_for_index", timer="nomad.worker.wait_for_index"

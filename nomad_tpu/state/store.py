@@ -699,37 +699,52 @@ class StateStore(StateSnapshot):
         """Apply a committed plan atomically: stops/evictions, preempted
         allocs, then placements (state_store.go UpsertPlanResults)."""
         with self._lock:
-            updates: list[Allocation] = []
-            for allocs in result.node_update.values():
-                updates.extend(allocs)
-            for allocs in result.node_preemptions.values():
-                updates.extend(allocs)
-            for allocs in result.node_allocation.values():
-                updates.extend(allocs)
-            self._upsert_allocs_locked(index, updates)
-            for allocs in result.node_allocation.values():
-                for a in allocs:
-                    self._csi_claim_for_alloc_locked(index, a)
-            for du in result.deployment_updates:
-                self._update_deployment_status_locked(
-                    index,
-                    du["deployment_id"],
-                    du["status"],
-                    du.get("description", ""),
-                )
-            if result.deployment is not None:
-                table = self._own("deployments")
-                d = result.deployment
-                existing = table.get(d.id)
-                d.create_index = existing.create_index if existing else index
-                d.modify_index = index
-                table[d.id] = d
-                self._idx_add(
-                    self._own("deployments_by_job"),
-                    (d.namespace, d.job_id),
-                    d.id,
-                )
+            self._apply_plan_result_locked(index, result)
             self._bump(index, "allocs", "deployments")
+
+    def upsert_merged_plan_results(
+        self, index: int, results: list[PlanResult]
+    ) -> None:
+        """Apply a whole batched pass's committed member results as ONE
+        store transaction: every member's stops/preemptions/placements
+        land under a single lock acquisition and a single index bump, so
+        a batch of B plans costs one listener fan-out instead of B."""
+        with self._lock:
+            for result in results:
+                self._apply_plan_result_locked(index, result)
+            self._bump(index, "allocs", "deployments")
+
+    def _apply_plan_result_locked(self, index: int, result: PlanResult) -> None:
+        updates: list[Allocation] = []
+        for allocs in result.node_update.values():
+            updates.extend(allocs)
+        for allocs in result.node_preemptions.values():
+            updates.extend(allocs)
+        for allocs in result.node_allocation.values():
+            updates.extend(allocs)
+        self._upsert_allocs_locked(index, updates)
+        for allocs in result.node_allocation.values():
+            for a in allocs:
+                self._csi_claim_for_alloc_locked(index, a)
+        for du in result.deployment_updates:
+            self._update_deployment_status_locked(
+                index,
+                du["deployment_id"],
+                du["status"],
+                du.get("description", ""),
+            )
+        if result.deployment is not None:
+            table = self._own("deployments")
+            d = result.deployment
+            existing = table.get(d.id)
+            d.create_index = existing.create_index if existing else index
+            d.modify_index = index
+            table[d.id] = d
+            self._idx_add(
+                self._own("deployments_by_job"),
+                (d.namespace, d.job_id),
+                d.id,
+            )
 
     # -- CSI volume writers ------------------------------------------------
     def upsert_csi_volume(self, index: int, vol) -> None:

@@ -109,6 +109,36 @@ class Plan:
 
 
 @dataclass(slots=True)
+class MergedPlan:
+    """One batched pass's plans coalesced into a single commit unit.
+
+    Member plans stay intact — per-eval attribution on every placement,
+    update, and preemption is the member plan itself — so the applier can
+    verify the UNION of touched nodes once, yet still reject (and hand a
+    ``refresh_index`` retry to) exactly the member whose placements went
+    stale, without failing its batch siblings. The whole merged result
+    lands as ONE FSM entry and one store index bump, which is the entire
+    point: a batched device pass that scored B evals in one kernel call
+    no longer pays B serialized verify/commit round trips.
+    """
+
+    plans: list[Plan] = field(default_factory=list)
+
+    @property
+    def priority(self) -> int:
+        """Queue priority: a merged entry sorts by its most urgent member
+        (the batch dequeue already grouped by readiness, not priority)."""
+        return max((p.priority for p in self.plans), default=50)
+
+    def eval_ids(self) -> list[str]:
+        return [p.eval_id for p in self.plans]
+
+    def normalize(self) -> None:
+        for p in self.plans:
+            p.normalize()
+
+
+@dataclass(slots=True)
 class PlanResult:
     """What the applier actually committed."""
 

@@ -122,6 +122,57 @@ class TestEvalBroker:
         with pytest.raises(ValueError):
             b.ack(e.id, "wrong-token")
 
+    def test_unack_timeout_redelivers(self):
+        """A dead worker's dequeued eval is redelivered once the unack
+        deadline expires — and its stale token is rejected after."""
+        b = make_broker(
+            unack_timeout=0.05, initial_nack_delay=0.01, nack_delay=0.01
+        )
+        e = ev()
+        b.enqueue(e)
+        got, stale_token = b.dequeue(["service"], timeout=1)
+        assert got is e
+        # worker dies here: no ack, no nack
+        got2, t2 = b.dequeue(["service"], timeout=2)
+        assert got2 is not None and got2.id == e.id
+        import pytest
+
+        with pytest.raises(ValueError):
+            b.ack(e.id, stale_token)  # late ack from the dead worker
+        b.ack(e.id, t2)
+        assert not b.outstanding(e.id)
+
+    def test_unack_timeout_releases_job_gate(self):
+        """Per-job serialization must not wedge a job forever behind a
+        dead worker: expiry releases the gate for deferred evals too."""
+        b = make_broker(
+            unack_timeout=0.05,
+            initial_nack_delay=0.01,
+            nack_delay=0.01,
+            delivery_limit=1,
+        )
+        e1, e2 = ev(job="same"), ev(job="same")
+        b.enqueue(e1)
+        b.enqueue(e2)
+        got, _token = b.dequeue(["service"], timeout=1)
+        assert got is e1
+        # worker dies; expiry hits the delivery limit → _failed, and the
+        # deferred sibling must be promoted through the open gate
+        got2, t2 = b.dequeue(["service"], timeout=2)
+        assert got2 is not None and got2.id == e2.id
+        assert b.failed_count() == 1
+        b.ack(got2.id, t2)
+
+    def test_unack_timeout_disabled(self):
+        b = make_broker(unack_timeout=None)
+        e = ev()
+        b.enqueue(e)
+        got, token = b.dequeue(["service"], timeout=1)
+        time.sleep(0.1)
+        got2, _ = b.dequeue(["service"], timeout=0.05)
+        assert got2 is None  # never redelivered
+        b.ack(e.id, token)
+
 
 class TestBlockedEvals:
     def test_block_and_unblock(self):

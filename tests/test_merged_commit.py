@@ -1,0 +1,249 @@
+"""Merged-commit semantics: one coalesced verify/apply per batched pass.
+
+Covers the plan_apply.go partial-commit contract lifted to a BATCH of
+member plans: the union of touched nodes is verified in one pass, commits
+land per MEMBER (a stale member is rejected with its own refresh_index
+without failing siblings), and the whole batch is one applier commit /
+one store index bump / one plan-queue entry.
+"""
+
+import time
+
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.broker.plan_apply import (
+    PlanApplier,
+    evaluate_merged_plan,
+    evaluate_plan,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import ComparableResources, MergedPlan, Plan
+from nomad_tpu.utils.metrics import global_metrics as metrics
+
+
+def normalized_alloc(node, cpu=500, mem=256):
+    """A placement as the applier sees it post-Plan.normalize(): no job
+    back-reference, explicit comparable resources."""
+    a = mock.alloc(n=node, client_status="pending")
+    a.job = None
+    a.resources = ComparableResources(
+        cpu=cpu, memory_mb=mem, disk_mb=150, bandwidth_mbits=0
+    )
+    return a
+
+
+def member_plan(eval_id, node, allocs):
+    p = Plan(eval_id=eval_id)
+    p.node_allocation[node.id] = list(allocs)
+    return p
+
+
+class TestEvaluateMergedPlan:
+    def test_union_fits_commits_every_member(self):
+        s = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        s.upsert_node(1, n1)
+        s.upsert_node(2, n2)
+        plans = [
+            member_plan("e1", n1, [normalized_alloc(n1)]),
+            member_plan("e2", n2, [normalized_alloc(n2)]),
+            member_plan("e3", n1, [normalized_alloc(n1)]),
+        ]
+        results = evaluate_merged_plan(s, plans)
+        assert len(results) == 3
+        for p, r in zip(plans, results):
+            assert not r.rejected_nodes and r.refresh_index == 0
+            node_id = next(iter(p.node_allocation))
+            got = [a.id for a in r.node_allocation[node_id]]
+            want = [a.id for a in p.node_allocation[node_id]]
+            assert got == want  # per-member attribution
+
+    def test_partial_commit_per_member(self):
+        """Two members pile onto one node; only the second overflows it.
+        The first commits untouched, the second alone is rejected with a
+        refresh_index — the per-eval partial-commit contract."""
+        s = StateStore()
+        n = mock.node()  # 4000 cpu − 100 reserved = 3900 usable
+        s.upsert_node(7, n)
+        plans = [
+            member_plan("e1", n, [normalized_alloc(n, cpu=2000)]),
+            member_plan("e2", n, [normalized_alloc(n, cpu=2500)]),
+        ]
+        results = evaluate_merged_plan(s, plans)
+        r1, r2 = results
+        assert not r1.rejected_nodes
+        assert len(r1.node_allocation[n.id]) == 1
+        assert r2.rejected_nodes == [n.id]
+        assert r2.refresh_index == s.latest_index
+        assert not r2.node_allocation
+
+    def test_rejected_member_stops_still_commit(self):
+        """A member whose placement no longer fits still lands its stops
+        (they only free capacity) — same rule as the single-plan path."""
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(3, n)
+        victim = normalized_alloc(n, cpu=500)
+        victim.client_status = "running"
+        s.upsert_allocs(4, [victim])
+        p1 = member_plan("e1", n, [normalized_alloc(n, cpu=3000)])
+        p2 = member_plan("e2", n, [normalized_alloc(n, cpu=3000)])
+        p2.node_update[n.id] = [victim]
+        results = evaluate_merged_plan(s, [p1, p2])
+        r1, r2 = results
+        assert not r1.rejected_nodes
+        assert r2.rejected_nodes == [n.id]
+        assert [a.id for a in r2.node_update[n.id]] == [victim.id]
+
+    def test_matches_sequential_single_plan_verify(self):
+        """With no cross-member contention the merged verify must be
+        indistinguishable from running evaluate_plan per member."""
+        s = StateStore()
+        nodes = [mock.node() for _ in range(4)]
+        for i, n in enumerate(nodes):
+            s.upsert_node(i + 1, n)
+        plans = [
+            member_plan(f"e{i}", n, [normalized_alloc(n), normalized_alloc(n)])
+            for i, n in enumerate(nodes)
+        ]
+        merged = evaluate_merged_plan(s, plans)
+        for p, mr in zip(plans, merged):
+            sr = evaluate_plan(s, p)
+            assert mr.rejected_nodes == sr.rejected_nodes
+            assert {
+                nid: [a.id for a in al]
+                for nid, al in mr.node_allocation.items()
+            } == {
+                nid: [a.id for a in al]
+                for nid, al in sr.node_allocation.items()
+            }
+
+
+class TestMergedApply:
+    def test_one_commit_one_index_bump(self):
+        """The whole batch lands as ONE store transaction: a single index
+        bump shared by every member's alloc_index."""
+        s = StateStore()
+        n1, n2 = mock.node(), mock.node()
+        s.upsert_node(1, n1)
+        s.upsert_node(2, n2)
+        before = s.latest_index
+        applier = PlanApplier(s)
+        mplan = MergedPlan(plans=[
+            member_plan("e1", n1, [normalized_alloc(n1)]),
+            member_plan("e2", n2, [normalized_alloc(n2)]),
+        ])
+        results, timings = applier.apply_merged(mplan)
+        assert s.latest_index == before + 1
+        assert [r.alloc_index for r in results] == [before + 1, before + 1]
+        stored = {a.id for a in s.allocs()}
+        for p in mplan.plans:
+            for allocs in p.node_allocation.values():
+                assert {a.id for a in allocs} <= stored
+        assert timings["apply_s"] >= timings["evaluate_s"]
+
+    def test_plan_queue_single_entry_per_batch(self):
+        """enqueue_merged: one pending entry, one future per member,
+        resolved together by one applier pass."""
+        from nomad_tpu.broker.plan_queue import PlanApplyLoop, PlanQueue
+
+        s = StateStore()
+        n = mock.node()
+        s.upsert_node(1, n)
+        q = PlanQueue()
+        q.set_enabled(True)
+        loop = PlanApplyLoop(s, q)
+        metrics.reset()
+        loop.start()
+        try:
+            mplan = MergedPlan(plans=[
+                member_plan("e1", n, [normalized_alloc(n, cpu=2000)]),
+                member_plan("e2", n, [normalized_alloc(n, cpu=2500)]),
+            ])
+            futures = q.enqueue_merged(mplan)
+            assert len(futures) == 2
+            r1 = futures[0].result(timeout=5)
+            r2 = futures[1].result(timeout=5)
+        finally:
+            loop.stop()
+        assert not r1.rejected_nodes
+        assert r2.rejected_nodes == [n.id] and r2.refresh_index
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("nomad.plan.merged_commits") == 1.0
+        assert snap.get("nomad.plan.commits") == 1.0
+
+
+class TestBatchedPassHarness:
+    def _drive_one_batch(self, server, n_jobs):
+        from nomad_tpu.server.worker import SCHEDULER_TYPES, Worker
+
+        for _ in range(3):
+            server.register_node(mock.node())
+        jobs = []
+        for j in range(n_jobs):
+            job = mock.job()
+            job.id = f"merged-{j}"
+            job.task_groups[0].count = 2
+            server.register_job(job)
+            jobs.append(job)
+        metrics.reset()
+        w = Worker(server, worker_id=0)
+        batch = server.eval_broker.dequeue_many(
+            SCHEDULER_TYPES, n_jobs, timeout=2
+        )
+        assert len(batch) == n_jobs
+        w._run_batch(batch)
+        w._join_commit()
+        return jobs
+
+    def test_one_applier_commit_per_batched_pass(self):
+        """The acceptance gate: a batched pass of B evals produces exactly
+        ONE applier commit carrying B member plans."""
+        from nomad_tpu.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_workers=0))
+        server.establish_leadership()
+        try:
+            n_jobs = 4
+            jobs = self._drive_one_batch(server, n_jobs)
+            snap = metrics.snapshot()["counters"]
+            assert snap.get("nomad.plan.merged_commits") == 1.0
+            assert snap.get("nomad.plan.commits") == 1.0
+            assert snap.get("nomad.plan.merged_members") == float(n_jobs)
+            assert snap.get("nomad.worker.batch_evals_completed") == float(
+                n_jobs
+            )
+            assert not snap.get("nomad.worker.batch_single_fallbacks")
+            for job in jobs:
+                live = [
+                    a
+                    for a in server.store.allocs_by_job("default", job.id)
+                    if not a.terminal_status()
+                ]
+                assert len(live) == 2
+                ev = server.store.evals_by_job("default", job.id)[0]
+                assert ev.status == "complete"
+        finally:
+            server.shutdown()
+
+    def test_overlay_exact_under_merged_commit(self):
+        """The shared overlay's prediction (base + deltas) must equal the
+        committed usage exactly once the merged commit lands — merged
+        commits must not change what the overlay reserves."""
+        from nomad_tpu.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_workers=0))
+        server.establish_leadership()
+        try:
+            self._drive_one_batch(server, 4)
+            ov = server.placement_overlay
+            # markers balanced: nothing left in flight after the join
+            assert ov._commits == 0 and ov._passes == 0
+            predicted = ov._base + ov._delta
+            ct = server.device_cache.tensors(server.store.snapshot())
+            assert np.allclose(predicted, np.asarray(ct.used))
+            # a fresh worker iteration may now retire the epoch
+            assert ov.maybe_reset()
+        finally:
+            server.shutdown()

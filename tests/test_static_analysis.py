@@ -1,4 +1,4 @@
-"""nomad_tpu.analysis: lint rules (NTA001-006), baseline ratchet, CLI,
+"""nomad_tpu.analysis: lint rules (NTA001-007), baseline ratchet, CLI,
 runtime lock-graph race detector, and jit-retrace budget checker.
 
 Every rule gets a trigger + non-trigger fixture through the
@@ -24,6 +24,7 @@ from nomad_tpu.analysis.rules import REGISTRY
 from nomad_tpu.analysis.rules.determinism import WallClockInScoringPath
 from nomad_tpu.analysis.rules.hostsync import HostSyncInJitKernel
 from nomad_tpu.analysis.rules.lockfields import LockDiscipline
+from nomad_tpu.analysis.rules.mergedsubmit import MergedSubmitDiscipline
 from nomad_tpu.analysis.rules.planfreeze import PlanMutationAfterSubmit
 from nomad_tpu.analysis.rules.spans import SpanCoverage
 from nomad_tpu.analysis.rules.swallow import SilentExceptionSwallow
@@ -359,6 +360,70 @@ class TestNTA006:
         )
 
 
+# -- NTA007: batched passes submit through the merged plan queue -----------
+
+
+class TestNTA007:
+    def test_per_eval_enqueue_in_commit_thread_triggers(self):
+        src = (
+            "class Worker:\n"
+            "    def _commit_batch_inner(self, members):\n"
+            "        for m in members:\n"
+            "            self.server.plan_queue.enqueue(m.plan)\n"
+        )
+        fs = run(src, "nomad_tpu/server/worker.py", MergedSubmitDiscipline)
+        assert rule_ids(fs) == ["NTA007"]
+        assert fs[0].symbol == "Worker._commit_batch_inner"
+
+    def test_submit_plan_in_run_batch_triggers(self):
+        src = (
+            "class Worker:\n"
+            "    def _run_batch(self, batch):\n"
+            "        for ev, sched in batch:\n"
+            "            sched.planner.submit_plan(sched.plan)\n"
+        )
+        fs = run(src, "nomad_tpu/server/worker.py", MergedSubmitDiscipline)
+        assert rule_ids(fs) == ["NTA007"]
+
+    def test_enqueue_merged_is_the_sanctioned_path(self):
+        src = (
+            "class Worker:\n"
+            "    def _commit_batch_inner(self, members, mplan):\n"
+            "        return self.server.plan_queue.enqueue_merged(mplan)\n"
+        )
+        assert (
+            run(src, "nomad_tpu/server/worker.py", MergedSubmitDiscipline)
+            == []
+        )
+
+    def test_individual_fallback_path_is_exempt(self):
+        src = (
+            "class Worker:\n"
+            "    def _run_one(self, ev, token):\n"
+            "        self.planner.submit_plan(self.plan)\n"
+        )
+        assert (
+            run(src, "nomad_tpu/server/worker.py", MergedSubmitDiscipline)
+            == []
+        )
+
+    def test_other_modules_out_of_scope(self):
+        rule = MergedSubmitDiscipline()
+        assert rule.applies_to("nomad_tpu/server/worker.py")
+        assert not rule.applies_to("nomad_tpu/scheduler/generic.py")
+
+    def test_worker_at_head_is_clean(self):
+        """The real worker must already obey its own rule — the batch path
+        has no per-eval submits to ratchet."""
+        path = os.path.join(REPO_ROOT, "nomad_tpu", "server", "worker.py")
+        with open(path) as f:
+            src = f.read()
+        assert (
+            run(src, "nomad_tpu/server/worker.py", MergedSubmitDiscipline)
+            == []
+        )
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -428,6 +493,7 @@ class TestBaselineRatchet:
     def test_registry_covers_all_rules(self):
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
+            "NTA007",
         ]
 
 
