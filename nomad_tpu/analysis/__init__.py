@@ -4,11 +4,12 @@ Three engines behind one CLI (``python -m nomad_tpu.analysis``) and one
 fast pytest entry point (tests/test_static_analysis.py):
 
 - ``lint``    — an AST visitor framework plus repo-specific rules
-  (NTA001–NTA007) that encode the invariants the north star depends on
+  (NTA001–NTA008) that encode the invariants the north star depends on
   but the test suite cannot see: trace-pure device kernels, deterministic
   scheduler scoring, observable exception handling, frozen plans after
-  submission, class-level lock discipline, and the worker batch path's
-  merged-submit discipline.
+  submission, class-level lock discipline, the worker batch path's
+  merged-submit discipline, and injectable-clock time in broker/server
+  scheduling paths (so chaos skew faults and replay can steer them).
 - ``race``    — an env-gated (``NOMAD_TPU_RACECHECK=1``) instrumented
   ``threading.Lock``/``RLock`` wrapper that records per-thread lock
   acquisition order, builds the global lock graph, and reports cycles

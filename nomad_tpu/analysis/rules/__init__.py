@@ -9,6 +9,7 @@ from .planfreeze import PlanMutationAfterSubmit  # noqa: E402
 from .lockfields import LockDiscipline  # noqa: E402
 from .spans import SpanCoverage  # noqa: E402
 from .mergedsubmit import MergedSubmitDiscipline  # noqa: E402
+from .wallclock import BareWallClockInBrokerServer  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -18,6 +19,7 @@ REGISTRY = [
     LockDiscipline,  # NTA005
     SpanCoverage,  # NTA006
     MergedSubmitDiscipline,  # NTA007
+    BareWallClockInBrokerServer,  # NTA008
 ]
 
 __all__ = ["REGISTRY"]

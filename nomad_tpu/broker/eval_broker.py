@@ -17,6 +17,7 @@ import uuid
 import zlib
 from typing import Optional
 
+from ..chaos.plane import chaos_site
 from ..structs import Evaluation
 from ..structs.evaluation import EVAL_DELIVERY_LIMIT
 
@@ -61,9 +62,15 @@ class EvalBroker:
         delivery_limit: int = EVAL_DELIVERY_LIMIT,
         n_partitions: int = 1,
         unack_timeout: Optional[float] = DEFAULT_UNACK_TIMEOUT,
+        clock=None,
     ):
         self._lock = threading.Condition()
         self.enabled = False
+        # injectable wall clock (the GenericScheduler clock= pattern,
+        # NTA008): delay-heap firing times and unack redelivery
+        # deadlines all read it, so chaos clock-skew faults reach the
+        # broker's time-based behavior
+        self._clock = clock if clock is not None else time.time
         self.nack_delay = nack_delay
         self.initial_nack_delay = initial_nack_delay
         self.delivery_limit = delivery_limit
@@ -101,6 +108,17 @@ class EvalBroker:
             "total_waiting": 0,
             "total_failed": 0,
         }
+        # at-least-once conservation ledger (chaos invariant: every
+        # dequeue resolves as exactly one ack, nack, or unack timeout)
+        self.counters = {
+            "enqueues": 0,
+            "dequeues": 0,
+            "acks": 0,
+            "nacks": 0,
+            "unack_timeouts": 0,
+            "chaos_dup_enqueues": 0,
+            "chaos_dropped_deliveries": 0,
+        }
 
     # -- lifecycle ---------------------------------------------------------
     def set_enabled(self, enabled: bool) -> None:
@@ -132,7 +150,8 @@ class EvalBroker:
     def _enqueue_locked(self, ev: Evaluation, ignore_job_gate: bool = False) -> None:
         if not self.enabled:
             return
-        now = time.time()
+        self.counters["enqueues"] += 1
+        now = self._clock()
         if ev.wait_until_unix and ev.wait_until_unix > now:
             heapq.heappush(
                 self._delayed, (ev.wait_until_unix, next(self._seq), ev)
@@ -155,7 +174,7 @@ class EvalBroker:
 
     def _drain_delayed_locked(self) -> float:
         """Move due delayed evals to ready; return seconds to next firing."""
-        now = time.time()
+        now = self._clock()
         wait = 3600.0
         while self._delayed:
             fire, _, ev = self._delayed[0]
@@ -182,6 +201,7 @@ class EvalBroker:
                 from ..utils.metrics import global_metrics
 
                 global_metrics.incr("nomad.broker.unack_timeouts")
+                self.counters["unack_timeouts"] += 1
                 self._redeliver_locked(ev)
             for _ev, _tok, deadline in self._unack.values():
                 wait = min(wait, max(deadline - now, 0.001))
@@ -226,7 +246,7 @@ class EvalBroker:
         explicit non-blocking poll. ``partition`` restricts the scan to
         one job-hash partition (concurrent batching workers); None scans
         every partition."""
-        deadline = None if timeout is None else time.time() + timeout
+        deadline = None if timeout is None else self._clock() + timeout
         keys = self._scan_keys(schedulers, partition)
         with self._lock:
             while True:
@@ -259,7 +279,7 @@ class EvalBroker:
                     ev = best.pop()
                     token = str(uuid.uuid4())
                     deadline = (
-                        time.time() + self.unack_timeout
+                        self._clock() + self.unack_timeout
                         if self.unack_timeout is not None
                         else float("inf")
                     )
@@ -268,14 +288,23 @@ class EvalBroker:
                     self._delivery_count[ev.id] = (
                         self._delivery_count.get(ev.id, 0) + 1
                     )
+                    self.counters["dequeues"] += 1
                     t_ready = self._enqueued_at.pop(ev.id, None)
                     if t_ready is not None:
-                        self._queue_waits[ev.id] = time.time() - t_ready
+                        self._queue_waits[ev.id] = self._clock() - t_ready
+                    if chaos_site("broker.dequeue") == "drop":
+                        # delivered-but-lost: the eval is charged as a
+                        # dequeue and sits unacked, so the redelivery
+                        # deadline sweep must hand it out exactly once
+                        # more — the caller sees an empty poll
+                        self.counters["chaos_dropped_deliveries"] += 1
+                        self._queue_waits.pop(ev.id, None)
+                        return None, ""
                     return ev, token
                 if deadline is None:
                     self._lock.wait(min(next_delay, 1.0))
                     continue
-                remaining = deadline - time.time()
+                remaining = deadline - self._clock()
                 if remaining <= 0:
                     return None, ""
                 self._lock.wait(min(remaining, next_delay, 1.0))
@@ -330,14 +359,29 @@ class EvalBroker:
             return self._queue_waits.pop(eval_id, 0.0)
 
     def ack(self, eval_id: str, token: str) -> None:
+        # consulted outside the lock: a "delay" here models a *late*
+        # ack, which may lose the race against the unack-deadline sweep
+        # (the worker then sees ValueError, a swallow site it accounts)
+        action = chaos_site("broker.ack")
+        if action == "drop":
+            # lost ack: the eval stays unacked and the deadline sweep
+            # redelivers it — reprocessing must converge to a no-op
+            return
         with self._lock:
             ev = self._validate(eval_id, token)
             del self._unack[eval_id]
+            self.counters["acks"] += 1
             self._delivery_count.pop(eval_id, None)
             self._queue_waits.pop(eval_id, None)
             job_key = (ev.namespace, ev.job_id)
             self._in_flight_jobs.discard(job_key)
             self._promote_pending_locked(job_key)
+            if action == "duplicate":
+                # at-least-once duplicate delivery: the acked eval is
+                # re-enqueued once (behind the job gate, like any real
+                # duplicate) and must reprocess to a no-op
+                self.counters["chaos_dup_enqueues"] += 1
+                self._enqueue_locked(ev)
             self._lock.notify_all()
 
     def nack(self, eval_id: str, token: str) -> None:
@@ -346,6 +390,7 @@ class EvalBroker:
         with self._lock:
             ev = self._validate(eval_id, token)
             del self._unack[eval_id]
+            self.counters["nacks"] += 1
             self._queue_waits.pop(eval_id, None)
             self._redeliver_locked(ev)
             self._lock.notify_all()
@@ -367,7 +412,7 @@ class EvalBroker:
             )
             heapq.heappush(
                 self._delayed,
-                (time.time() + delay, next(self._seq), ev),
+                (self._clock() + delay, next(self._seq), ev),
             )
 
     # -- introspection -----------------------------------------------------
@@ -388,3 +433,39 @@ class EvalBroker:
         with self._lock:
             q = self._ready.get(FAILED_QUEUE)
             return len(q) if q else 0
+
+    def failed_eval_ids(self) -> list[str]:
+        """Evals parked past the delivery limit (chaos accounting: a
+        failed eval explains a job stuck short of its desired count)."""
+        with self._lock:
+            q = self._ready.get(FAILED_QUEUE)
+            return [entry[2].id for entry in q._h] if q else []
+
+    def tracked_eval_ids(self) -> set[str]:
+        """Every eval id the broker still holds anywhere — ready
+        queues, unacked, delayed heap, or deferred behind a job gate.
+        The chaos invariant checker uses this to prove no non-terminal
+        eval in the store has been stranded."""
+        with self._lock:
+            ids: set[str] = set()
+            for q in self._ready.values():
+                ids.update(entry[2].id for entry in q._h)
+            ids.update(self._unack.keys())
+            ids.update(entry[2].id for entry in self._delayed)
+            for q in self._pending_by_job.values():
+                ids.update(entry[2].id for entry in q._h)
+            return ids
+
+    def queue_depths(self) -> dict[str, int]:
+        """One consistent snapshot of every queue depth (the chaos
+        runner's quiesce predicate: all zeros except _failed)."""
+        with self._lock:
+            return {
+                "ready": sum(
+                    len(q) for t, q in self._ready.items() if t != FAILED_QUEUE
+                ),
+                "unacked": len(self._unack),
+                "delayed": len(self._delayed),
+                "deferred": sum(len(q) for q in self._pending_by_job.values()),
+                "failed": len(self._ready.get(FAILED_QUEUE, ())),
+            }

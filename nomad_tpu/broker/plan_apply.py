@@ -22,6 +22,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..chaos.plane import active_plane, chaos_site, note_committed
 from ..obs.trace import global_tracer as tracer
 from ..structs import (
     Allocation,
@@ -405,6 +406,7 @@ class PlanApplier:
             with tracer.span(
                 "plan_apply.evaluate", timer="nomad.plan.evaluate"
             ):
+                chaos_site("plan_apply.verify")
                 result = evaluate_plan(self.store, plan)
             if sp is not None:
                 sp.tags["rejected_nodes"] = len(result.rejected_nodes)
@@ -413,7 +415,22 @@ class PlanApplier:
                     preemption_evals(self.store, result)
                     if result.node_preemptions else []
                 )
+                # ledger wants fresh placements only: an id already in
+                # the store is an in-place update, not a placement
+                fresh = (
+                    [
+                        a.id
+                        for allocs in result.node_allocation.values()
+                        for a in allocs
+                        if self.store.alloc_by_id(a.id) is None
+                    ]
+                    if active_plane() is not None
+                    else ()
+                )
                 with tracer.span("plan_apply.commit"):
+                    # before the commit executes: a raise here aborts
+                    # cleanly (nothing lands, the waiter sees the error)
+                    chaos_site("plan_apply.commit")
                     if self.commit is not None:
                         index = self.commit(result, plan.eval_id, evals)
                     else:
@@ -425,6 +442,7 @@ class PlanApplier:
                             self.store.upsert_evals(
                                 self.store.latest_index + 1, evals
                             )
+                note_committed(fresh)
                 # commit-train accounting: one FSM apply, one plan landed
                 metrics.incr("nomad.plan.commits")
                 metrics.incr("nomad.plan.committed_plans")
@@ -448,6 +466,7 @@ class PlanApplier:
         t_apply = time.perf_counter()
         with self._lock:
             t0 = time.perf_counter()
+            chaos_site("plan_apply.verify")
             results = evaluate_merged_plan(self.store, mplan.plans)
             evaluate_s = time.perf_counter() - t0
             metrics.measure("nomad.plan.evaluate", evaluate_s)
@@ -465,6 +484,18 @@ class PlanApplier:
                     evals.extend(preemption_evals(self.store, res))
             t0 = time.perf_counter()
             if commit_members:
+                fresh = (
+                    [
+                        a.id
+                        for _eid, res in commit_members
+                        for allocs in res.node_allocation.values()
+                        for a in allocs
+                        if self.store.alloc_by_id(a.id) is None
+                    ]
+                    if active_plane() is not None
+                    else ()
+                )
+                chaos_site("plan_apply.commit")
                 committed = [res for _eid, res in commit_members]
                 eval_ids = [eid for eid, _res in commit_members]
                 if self.commit_merged is not None:
@@ -494,6 +525,7 @@ class PlanApplier:
                 )
                 for _eid, res in commit_members:
                     res.alloc_index = index
+                note_committed(fresh)
                 if evals and self.on_evals_created is not None:
                     self.on_evals_created([
                         self.store.eval_by_id(e.id) or e for e in evals

@@ -20,6 +20,7 @@ import time
 from concurrent.futures import Future
 from typing import Optional
 
+from ..chaos.plane import chaos_site
 from ..obs.trace import global_tracer as tracer
 from ..structs import MergedPlan, Plan, PlanResult
 from ..utils.metrics import global_metrics as metrics
@@ -79,6 +80,9 @@ class PlanQueue:
             self._lock.notify_all()
 
     def enqueue(self, plan: Plan) -> Future:
+        # raise faults here surface on the submitting worker, which
+        # must nack the eval back to the broker for redelivery
+        chaos_site("plan_queue.enqueue")
         with self._lock:
             if not self.enabled:
                 f: Future = Future()
@@ -96,6 +100,10 @@ class PlanQueue:
         """Submit a whole batched pass as ONE pending entry; returns one
         result future per member plan, resolved together when the merged
         apply lands."""
+        # the caller is the worker's commit thread: a kill fault here is
+        # the "crash mid merged-plan submit" scenario — nothing enqueued,
+        # the batch's evals stay unacked, the deadline sweep redelivers
+        chaos_site("plan_queue.enqueue_merged")
         with self._lock:
             if not self.enabled:
                 futures: list[Future] = []

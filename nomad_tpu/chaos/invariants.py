@@ -1,0 +1,313 @@
+"""Cluster conservation laws, checked over a live quiesced cluster.
+
+The checks encode what the eval→plan→apply pipeline promises to keep
+true no matter which faults fired:
+
+``node_capacity``
+    no node's committed non-terminal allocations exceed its
+    reserved-adjusted capacity (the plan applier's verify step is the
+    only writer of placements, so an overcommit means verify lied).
+``plan_ledger``
+    every *fresh* placement the applier reported committed landed in
+    the store exactly once — no loss after a reported commit, no
+    double-commit of a merged-plan member. In-place updates of an
+    existing alloc (job scaled / re-registered) are not placements and
+    are excluded (requires an installed FaultPlane ledger).
+``index_monotonic``
+    the change journal's raft indexes never go backwards and the
+    store's latest index bounds every journaled write.
+``overlay_drained``
+    the SharedOverlay's pass/commit markers drain to zero once the
+    cluster quiesces — a leaked marker wedges ``maybe_reset`` forever.
+``broker_conservation``
+    every dequeue is resolved by exactly one of ack, nack, or
+    unack-deadline redelivery (at-least-once bookkeeping balances).
+``swallow_ring``
+    no swallowed-error counter increments without a matching flight-
+    recorder error-ring event (swallows can't hide from the obs plane).
+``job_conservation``
+    after quiesce every service job runs exactly its desired count of
+    allocations, or a live eval (pending/blocked in the store, or
+    parked in the broker's failed queue) accounts for the difference;
+    an unexplained surplus is the double-commit smoking gun.
+``eval_terminal``
+    no eval is stranded: every non-terminal eval in the store is still
+    tracked somewhere (broker queues, delayed heap, job gate, failed
+    queue, or the blocked-evals tracker).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..structs import allocs_fit
+from ..structs.evaluation import EVAL_STATUS_BLOCKED, EVAL_STATUS_PENDING
+
+INVARIANTS = (
+    "node_capacity",
+    "plan_ledger",
+    "index_monotonic",
+    "overlay_drained",
+    "broker_conservation",
+    "swallow_ring",
+    "job_conservation",
+    "eval_terminal",
+)
+
+
+class Violation:
+    __slots__ = ("invariant", "subject", "detail")
+
+    def __init__(self, invariant: str, subject: str, detail: str):
+        self.invariant = invariant
+        self.subject = subject
+        self.detail = detail
+
+    def row(self) -> str:
+        return f"{self.invariant}: {self.subject}: {self.detail}"
+
+    def __repr__(self):
+        return f"Violation({self.row()})"
+
+
+class InvariantReport:
+    def __init__(self):
+        self.checked: dict[str, bool] = {}
+        self.violations: list[Violation] = []
+        # free-form run stats for human rendering; excluded from the
+        # canonical dict because some (queue depths, retry counts) are
+        # timing-dependent while the verdicts are not
+        self.info: dict[str, object] = {}
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def _fail(self, invariant: str, subject: str, detail: str) -> None:
+        self.checked[invariant] = False
+        self.violations.append(Violation(invariant, subject, detail))
+
+    def to_dict(self) -> dict:
+        """Canonical form: deterministic for a deterministic workload."""
+        return {
+            "ok": self.ok,
+            "invariants": {
+                name: ("ok" if self.checked.get(name, True) else "violated")
+                for name in INVARIANTS
+            },
+            "violations": sorted(v.row() for v in self.violations),
+        }
+
+    def render(self) -> str:
+        lines = []
+        for name in INVARIANTS:
+            state = "ok" if self.checked.get(name, True) else "VIOLATED"
+            if name not in self.checked:
+                state = "skipped"
+            lines.append(f"  {name:<20s} {state}")
+        for v in self.violations:
+            lines.append(f"  !! {v.row()}")
+        return "\n".join(lines)
+
+
+def metrics_baseline() -> dict:
+    """Snapshot the swallow counters + error-ring total before a run so
+    the swallow_ring check measures only the run's own deltas."""
+    from ..obs.recorder import flight_recorder
+    from ..utils.metrics import global_metrics
+
+    counters = global_metrics.snapshot()["counters"]
+    swallowed = sum(
+        v for k, v in counters.items() if k.endswith(".swallowed_errors")
+    )
+    return {"swallowed": swallowed, "ring": flight_recorder.errors_total}
+
+
+def check_cluster(
+    server,
+    plane=None,
+    baseline: Optional[dict] = None,
+) -> InvariantReport:
+    """Run every conservation check against a (quiesced) live Server."""
+    from ..obs.recorder import flight_recorder
+    from ..utils.metrics import global_metrics
+
+    report = InvariantReport()
+    store = server.store
+    snap = store.snapshot()
+    broker = server.eval_broker
+
+    # -- node_capacity -----------------------------------------------------
+    report.checked["node_capacity"] = True
+    n_nodes = 0
+    for node in snap.nodes():
+        if node.terminal_status():
+            continue
+        n_nodes += 1
+        live = [
+            a for a in snap.allocs_by_node(node.id) if not a.terminal_status()
+        ]
+        fits, dim, used = allocs_fit(node, live, check_devices=True)
+        if not fits:
+            report._fail(
+                "node_capacity",
+                node.id,
+                f"{len(live)} live allocs overcommit {dim} (used {used})",
+            )
+    report.info["nodes"] = n_nodes
+
+    # -- plan_ledger -------------------------------------------------------
+    if plane is not None:
+        report.checked["plan_ledger"] = True
+        for alloc_id, count in sorted(plane.committed.items()):
+            if count != 1:
+                report._fail(
+                    "plan_ledger",
+                    alloc_id,
+                    f"placement committed {count} times (expected exactly 1)",
+                )
+            elif snap.alloc_by_id(alloc_id) is None:
+                report._fail(
+                    "plan_ledger",
+                    alloc_id,
+                    "committed placement missing from the state store",
+                )
+        report.info["ledger_commits"] = len(plane.committed)
+
+    # -- index_monotonic ---------------------------------------------------
+    report.checked["index_monotonic"] = True
+    journal = store.journal
+    with journal._lock:
+        entries = list(journal._entries)
+    prev = 0
+    for idx, table, key in entries:
+        if idx < prev:
+            report._fail(
+                "index_monotonic",
+                f"{table}/{key}",
+                f"journal index went backwards ({prev} -> {idx})",
+            )
+            break
+        prev = idx
+    if entries and entries[-1][0] > store.latest_index:
+        report._fail(
+            "index_monotonic",
+            "latest_index",
+            f"journal head {entries[-1][0]} > store latest "
+            f"{store.latest_index}",
+        )
+
+    # -- overlay_drained ---------------------------------------------------
+    overlay = getattr(server, "placement_overlay", None)
+    if overlay is not None:
+        report.checked["overlay_drained"] = True
+        with overlay._lock:
+            passes, commits = overlay._passes, overlay._commits
+        if passes or commits:
+            report._fail(
+                "overlay_drained",
+                "placement_overlay",
+                f"markers leaked after quiesce: passes={passes} "
+                f"commits={commits}",
+            )
+
+    # -- broker_conservation -----------------------------------------------
+    report.checked["broker_conservation"] = True
+    c = broker.counters
+    with broker._lock:
+        outstanding = len(broker._unack)
+    resolved = c["acks"] + c["nacks"] + c["unack_timeouts"]
+    if c["dequeues"] != resolved + outstanding:
+        report._fail(
+            "broker_conservation",
+            "eval_broker",
+            f"dequeues={c['dequeues']} != acks={c['acks']} + "
+            f"nacks={c['nacks']} + unack_timeouts={c['unack_timeouts']} "
+            f"+ outstanding={outstanding}",
+        )
+    if outstanding:
+        report._fail(
+            "broker_conservation",
+            "eval_broker",
+            f"{outstanding} evals still unacked after quiesce",
+        )
+    report.info["broker"] = dict(c)
+
+    # -- swallow_ring ------------------------------------------------------
+    report.checked["swallow_ring"] = True
+    now = metrics_baseline()
+    base = baseline or {"swallowed": 0, "ring": 0}
+    d_swallowed = now["swallowed"] - base["swallowed"]
+    d_ring = now["ring"] - base["ring"]
+    if d_swallowed > d_ring:
+        report._fail(
+            "swallow_ring",
+            "count_swallowed",
+            f"{d_swallowed} swallow counter bumps but only {d_ring} "
+            "error-ring events",
+        )
+    report.info["swallowed"] = d_swallowed
+
+    # -- job_conservation --------------------------------------------------
+    report.checked["job_conservation"] = True
+    failed_ids = set(broker.failed_eval_ids())
+    jobs_seen: set[tuple[str, str]] = set()
+    for job in snap.jobs():
+        jobs_seen.add((job.namespace, job.id))
+    # jobs that were deregistered but still have allocs on the books
+    for alloc in snap.allocs():
+        jobs_seen.add((alloc.namespace, alloc.job_id))
+    blocked = server.blocked_evals
+    for namespace, job_id in sorted(jobs_seen):
+        job = snap.job_by_id(namespace, job_id)
+        if job is not None and job.type != "service":
+            continue
+        desired = 0
+        if job is not None:
+            desired = sum(job.required_allocs().values())
+        live = [
+            a
+            for a in snap.allocs_by_job(namespace, job_id)
+            if not a.terminal_status()
+        ]
+        if len(live) == desired:
+            continue
+        accounted = any(
+            ev.status in (EVAL_STATUS_PENDING, EVAL_STATUS_BLOCKED)
+            or ev.id in failed_ids
+            for ev in snap.evals_by_job(namespace, job_id)
+        ) or blocked.get_blocked(namespace, job_id) is not None
+        if accounted:
+            continue
+        kind = "surplus" if len(live) > desired else "shortfall"
+        report._fail(
+            "job_conservation",
+            f"{namespace}/{job_id}",
+            f"unaccounted {kind}: {len(live)} live allocs vs desired "
+            f"{desired} with no outstanding eval",
+        )
+    report.info["jobs"] = len(jobs_seen)
+
+    # -- eval_terminal -----------------------------------------------------
+    report.checked["eval_terminal"] = True
+    tracked = broker.tracked_eval_ids()
+    tracked |= {ev.id for ev in server.blocked_evals.captured()}
+    for ev in snap.evals():
+        if ev.terminal_status() or ev.status == EVAL_STATUS_BLOCKED:
+            continue
+        if ev.id not in tracked:
+            report._fail(
+                "eval_terminal",
+                ev.id,
+                f"eval for {ev.namespace}/{ev.job_id} is {ev.status} but "
+                "tracked by no queue",
+            )
+
+    # context for the human-facing dump
+    report.info["ring_errors"] = len(flight_recorder.errors())
+    report.info["counters"] = {
+        k: v
+        for k, v in global_metrics.snapshot()["counters"].items()
+        if k.startswith("nomad.chaos.") or k.endswith(".swallowed_errors")
+    }
+    return report

@@ -739,6 +739,53 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_chaos_run(args) -> int:
+    """`nomad-tpu chaos run` — deterministic fault-injection run against
+    an in-process cluster (nomad_tpu.chaos). Deliberately NOT behind the
+    HTTP boundary: chaos needs to reach inside the broker/applier seams,
+    so it boots its own single-server cluster rather than dialing an
+    agent. Exit 0 on a clean invariant report, 1 on any violation."""
+    from ..chaos import FAULT_KINDS, run_chaos, shrink_schedule
+
+    faults = tuple(args.faults.split("+")) if args.faults else FAULT_KINDS
+    unknown = [f for f in faults if f not in FAULT_KINDS]
+    if unknown:
+        return _fail(
+            f"unknown fault kind(s) {'+'.join(unknown)}; "
+            f"choose from {'+'.join(FAULT_KINDS)}"
+        )
+    run = run_chaos(
+        seed=args.seed,
+        steps=args.steps,
+        faults=faults,
+        nodes=args.nodes,
+        rate=args.rate,
+    )
+    if args.json:
+        print(run.canonical_json())
+    else:
+        print(run.render(verbose=args.verbose))
+    if run.ok:
+        return 0
+    if args.shrink:
+        print("shrinking failing schedule...", file=sys.stderr)
+        minimal, fail = shrink_schedule(
+            seed=args.seed,
+            steps=args.steps,
+            faults=faults,
+            nodes=args.nodes,
+            rate=args.rate,
+            log=lambda m: print(m, file=sys.stderr),
+        )
+        if fail is None:
+            print("failure did not reproduce under shrink", file=sys.stderr)
+        else:
+            print(f"minimal failing schedule ({len(minimal)} faults):")
+            for spec in minimal:
+                print(f"  {spec.row()}")
+    return 1
+
+
 def cmd_operator_raft_list(args) -> int:
     """`nomad operator raft list-peers`
     (command/operator_raft_list.go)."""
@@ -1132,6 +1179,34 @@ def build_parser() -> argparse.ArgumentParser:
     )
     members = server.add_parser("members")
     members.set_defaults(fn=cmd_server_members)
+
+    chaos = sub.add_parser(
+        "chaos", help="deterministic fault injection"
+    ).add_subparsers(dest="chaos_cmd", required=True)
+    crun = chaos.add_parser(
+        "run", help="run a seeded in-process cluster under injected faults"
+    )
+    crun.add_argument("--seed", type=int, default=7)
+    crun.add_argument("--steps", type=int, default=200)
+    crun.add_argument(
+        "--faults",
+        default="",
+        help="'+'-joined subset of raise+delay+duplicate+drop+kill+skew "
+        "(default: all)",
+    )
+    crun.add_argument("--nodes", type=int, default=6)
+    crun.add_argument(
+        "--rate", type=float, default=0.04,
+        help="fraction of each site's call horizon that faults",
+    )
+    crun.add_argument("--json", action="store_true",
+                      help="emit the canonical (bit-reproducible) report")
+    crun.add_argument("--verbose", action="store_true",
+                      help="include timing-dependent diagnostics")
+    crun.add_argument("--shrink", action="store_true",
+                      help="on violation, shrink to a minimal failing "
+                      "fault subset")
+    crun.set_defaults(fn=cmd_chaos_run)
 
     return p
 

@@ -35,6 +35,10 @@ class FlightRecorder:
         # first; a re-processed eval re-records and moves to the tail
         self._traces: "OrderedDict[str, dict]" = OrderedDict()
         self._errors: deque = deque(maxlen=error_capacity)
+        # lifetime error-event count: the ring evicts, this doesn't, so
+        # conservation checks (chaos invariant: every swallowed-error
+        # counter bump has a ring event) survive ring wraparound
+        self.errors_total = 0
 
     # -- writes ------------------------------------------------------------
     def record(self, trace: dict) -> None:
@@ -50,6 +54,7 @@ class FlightRecorder:
         self, component: str, error: str, eval_id: str = ""
     ) -> None:
         with self._lock:
+            self.errors_total += 1
             self._errors.append(
                 {
                     "at_unix": time.time(),

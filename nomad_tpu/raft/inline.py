@@ -50,6 +50,14 @@ class InlineRaft:
 
     def apply(self, mtype: int, payload: Optional[dict] = None,
               timeout: float = 10.0) -> Tuple[int, Any]:
+        from ..chaos.plane import chaos_site, make_fault
+
+        # consulted before the entry is assigned an index: a "drop"
+        # rejects the write to the caller (a lost raft commit, like a
+        # leadership change mid-apply) — nothing is applied, nothing is
+        # durable, and the caller's retry path must cope
+        if chaos_site("fsm.apply") == "drop":
+            raise make_fault("fsm.apply")
         with self._lock:
             index = self.fsm.store.latest_index + 1
             if self._wal is not None:

@@ -11,15 +11,20 @@ import threading
 import time
 from typing import Optional
 
+from ..chaos.plane import chaos_site
 from ..structs import NODE_STATUS_DOWN
 
 DEFAULT_HEARTBEAT_TTL = 5.0
 
 
 class NodeHeartbeater:
-    def __init__(self, server, ttl: float = DEFAULT_HEARTBEAT_TTL):
+    def __init__(self, server, ttl: float = DEFAULT_HEARTBEAT_TTL, clock=None):
         self.server = server
         self.ttl = ttl
+        # injectable monotonic clock (the GenericScheduler clock=
+        # pattern, NTA008): TTL deadlines read it, so chaos clock-skew
+        # faults can expire or extend heartbeats deterministically
+        self._clock = clock if clock is not None else time.monotonic
         self._deadlines: dict[str, float] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -41,7 +46,7 @@ class NodeHeartbeater:
         """Reset the node's TTL timer; returns the TTL the client should
         beat within (Node.UpdateStatus heartbeat path)."""
         with self._lock:
-            self._deadlines[node_id] = time.monotonic() + self.ttl
+            self._deadlines[node_id] = self._clock() + self.ttl
         return self.ttl
 
     def initialize_from_store(self) -> None:
@@ -58,7 +63,7 @@ class NodeHeartbeater:
 
     def _run(self) -> None:
         while not self._stop.wait(min(self.ttl / 4.0, 0.5)):
-            now = time.monotonic()
+            now = self._clock()
             expired = []
             with self._lock:
                 for node_id, deadline in list(self._deadlines.items()):
@@ -68,6 +73,11 @@ class NodeHeartbeater:
             for node_id in expired:
                 node = self.server.store.node_by_id(node_id)
                 if node is None or node.terminal_status():
+                    continue
+                if chaos_site("heartbeat.expiry") == "drop":
+                    # missed sweep: the expiry is deferred, not lost —
+                    # re-arm the timer so the next sweep fires it
+                    self.heartbeat(node_id)
                     continue
                 # missed TTL ⇒ node down ⇒ reschedule evals fan out
                 self.server.update_node_status(node_id, NODE_STATUS_DOWN)

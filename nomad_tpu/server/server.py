@@ -54,6 +54,7 @@ class ServerConfig:
         acl_enabled: bool = False,
         data_dir: Optional[str] = None,
         num_batch_workers: int = 1,
+        clock=None,
     ):
         self.num_workers = num_workers
         self.region = region
@@ -61,6 +62,12 @@ class ServerConfig:
         self.deployment_watch_interval = deployment_watch_interval
         self.acl_enabled = acl_enabled
         self.data_dir = data_dir
+        # injectable cluster clock: an object with time() and
+        # monotonic() (e.g. chaos.ChaosClock). Threaded into the eval
+        # broker's delay/unack deadlines and the heartbeater's TTL
+        # timers so clock-skew faults reach every time-based decision;
+        # None means the real clock.
+        self.clock = clock
         # workers 0..n-1 run batched device passes, each on its own
         # job-hash partition of the eval stream (the rest drain solo
         # evals). >1 needs the broker's partitioned queues so two
@@ -72,8 +79,10 @@ class Server:
     def __init__(self, config: Optional[ServerConfig] = None):
         self.config = config or ServerConfig()
         self.store = StateStore()
+        clock = self.config.clock
         self.eval_broker = EvalBroker(
-            n_partitions=self.config.num_batch_workers
+            n_partitions=self.config.num_batch_workers,
+            clock=clock.time if clock is not None else None,
         )
         self.blocked_evals = BlockedEvals(broker=self.eval_broker)
         self.plan_queue = PlanQueue()
@@ -104,7 +113,11 @@ class Server:
         from .periodic import PeriodicDispatch
 
         self.drainer = NodeDrainer(self)
-        self.heartbeater = NodeHeartbeater(self, ttl=self.config.heartbeat_ttl)
+        self.heartbeater = NodeHeartbeater(
+            self,
+            ttl=self.config.heartbeat_ttl,
+            clock=clock.monotonic if clock is not None else None,
+        )
         self.deployment_watcher = DeploymentWatcher(
             self, interval=self.config.deployment_watch_interval
         )

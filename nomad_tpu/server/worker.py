@@ -31,6 +31,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..chaos.plane import ChaosThreadKill, chaos_site
 from ..obs.trace import global_tracer as tracer
 from ..scheduler import new_scheduler
 from ..structs import Evaluation, MergedPlan, Plan
@@ -484,6 +485,14 @@ class Worker:
             self._commit_batch_inner(
                 prepared, all_asks, results, lane_ok, singles
             )
+        except ChaosThreadKill as e:
+            # injected cooperative crash: die exactly like a killed
+            # commit thread — whatever was not yet acked stays unacked
+            # and the broker's redelivery deadline must recover it.
+            # BaseException, so no recovery handler above could absorb
+            # it; accounted here at the thread boundary, never silent.
+            metrics.incr("nomad.chaos.thread_kills")
+            count_swallowed("chaos", e)
         finally:
             self.server.placement_overlay.commit_finished()
 
@@ -506,6 +515,10 @@ class Worker:
         queue entry, one vectorized applier verify, one raft apply — and
         resolve each member from its own result future. A stale member
         falls back to the individual path without failing its siblings."""
+        # cooperative crash flag, checked where a real commit thread
+        # spends its life: once on entry, and again mid merged-plan
+        # commit (below) after the submit is in flight
+        chaos_site("worker.commit")
         server = self.server
         buf = _EvalBuffer(server)
         members: list[tuple] = []  # (ev, token, sched, member plan)
@@ -558,6 +571,11 @@ class Worker:
                     MergedPlan(plans=[m[3] for m in members]),
                     trace_ctxs=ctxs,
                 )
+                # a kill here crashes the thread AFTER the merged plan
+                # is in flight: the applier still commits it, nobody
+                # acks, and redelivered members must converge to no-ops
+                # (never lose or double-commit a member)
+                chaos_site("worker.commit")
                 for i, (ev, token, _sched, _member) in enumerate(members):
                     try:
                         mresults[i] = futures[i].result(timeout=30)

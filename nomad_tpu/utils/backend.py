@@ -179,6 +179,11 @@ def traced_jit(fn=None, *, trace_name=None, retrace_budget=None, **jit_kwargs):
 
     @functools.wraps(fn)
     def _profiled(*args, **kwargs):
+        from ..chaos.plane import chaos_site
+
+        # a raise here models a device-side failure (OOM, preempted
+        # TPU); the worker's batch path falls back to single-eval runs
+        chaos_site("kernel.execute")
         before = _trace_counts.get(name, 0)
         t0 = time.perf_counter()
         out = jitted(*args, **kwargs)

@@ -28,6 +28,7 @@ from nomad_tpu.analysis.rules.mergedsubmit import MergedSubmitDiscipline
 from nomad_tpu.analysis.rules.planfreeze import PlanMutationAfterSubmit
 from nomad_tpu.analysis.rules.spans import SpanCoverage
 from nomad_tpu.analysis.rules.swallow import SilentExceptionSwallow
+from nomad_tpu.analysis.rules.wallclock import BareWallClockInBrokerServer
 from nomad_tpu.utils import backend
 from nomad_tpu.utils.metrics import count_swallowed, global_metrics
 
@@ -424,6 +425,64 @@ class TestNTA007:
         )
 
 
+class TestNTA008:
+    def test_bare_time_and_sleep_trigger(self):
+        src = (
+            "import time\n"
+            "def sweep(self):\n"
+            "    now = time.time()\n"
+            "    time.sleep(0.1)\n"
+        )
+        fs = run(src, "nomad_tpu/broker/x.py", BareWallClockInBrokerServer)
+        assert rule_ids(fs) == ["NTA008", "NTA008"]
+
+    def test_module_alias_is_resolved(self):
+        src = "import time as _t\ndef f():\n    return _t.time()\n"
+        fs = run(src, "nomad_tpu/server/x.py", BareWallClockInBrokerServer)
+        assert rule_ids(fs) == ["NTA008"]
+
+    def test_from_import_aliases_are_resolved(self):
+        src = (
+            "from time import time as now, sleep\n"
+            "def f():\n    sleep(1)\n    return now()\n"
+        )
+        fs = run(src, "nomad_tpu/broker/x.py", BareWallClockInBrokerServer)
+        assert rule_ids(fs) == ["NTA008", "NTA008"]
+
+    def test_monotonic_and_injected_clock_are_clean(self):
+        src = (
+            "import time\n"
+            "def f(self):\n"
+            "    t0 = time.perf_counter()\n"
+            "    time.monotonic()\n"
+            "    return self._clock()\n"
+        )
+        assert (
+            run(src, "nomad_tpu/broker/x.py", BareWallClockInBrokerServer)
+            == []
+        )
+
+    def test_scope_is_broker_and_server_only(self):
+        rule = BareWallClockInBrokerServer()
+        assert rule.applies_to("nomad_tpu/broker/eval_broker.py")
+        assert rule.applies_to("nomad_tpu/server/heartbeat.py")
+        assert not rule.applies_to("nomad_tpu/scheduler/generic.py")
+        assert not rule.applies_to("tests/test_broker.py")
+
+    def test_broker_and_heartbeat_at_head_are_clean(self):
+        """The chaos PR threaded clock= through exactly these paths; the
+        rule holding them at zero is the point of the ratchet."""
+        for rel in (
+            os.path.join("nomad_tpu", "broker", "eval_broker.py"),
+            os.path.join("nomad_tpu", "broker", "plan_queue.py"),
+            os.path.join("nomad_tpu", "server", "heartbeat.py"),
+        ):
+            with open(os.path.join(REPO_ROOT, rel)) as f:
+                src = f.read()
+            assert run(src, rel.replace(os.sep, "/"),
+                       BareWallClockInBrokerServer) == [], rel
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -493,7 +552,7 @@ class TestBaselineRatchet:
     def test_registry_covers_all_rules(self):
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
-            "NTA007",
+            "NTA007", "NTA008",
         ]
 
 
