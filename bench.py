@@ -31,37 +31,34 @@ import time
 import numpy as np
 
 
-def _ensure_live_backend(attempts: int = 5, timeout_s: float = 120.0) -> bool:
+def _ensure_live_backend(timeout_s: float = 120.0) -> bool:
     """The axon TPU plugin can hang jax.devices() indefinitely when its
-    tunnel is down. Probe in a daemon thread, RETRYING ``attempts`` times
-    with a pause between attempts (tunnel hiccups are transient; a single
-    90 s probe silently cost round 2 its TPU number; 3×/120 s back-to-back
-    cost round 4 its); only after every attempt fails re-exec onto the CPU
-    backend so the driver still gets its JSON line. Returns True when the
-    run is a CPU fallback — callers must surface that loudly in the
-    machine-readable output, never as the scored metric's fine print.
-    Probe diagnostics travel into the fallback JSON via the re-exec env."""
+    tunnel is down. Probe ONCE in a daemon thread; a dead tunnel stays
+    dead within a bench invocation, so the old 5×120 s serial retry loop
+    (worst case 10+ minutes before the JSON line) is replaced by a single
+    probe whose negative result is cached across processes via
+    ``NOMAD_TPU_BACKEND_PROBE_CACHE`` — sibling bench subcommands in the
+    same driver run skip straight to CPU fallback. On a dead backend
+    re-exec onto the CPU backend so the driver still gets its JSON line.
+    Returns True when the run is a CPU fallback — callers must surface
+    that loudly in the machine-readable output, never as the scored
+    metric's fine print. Probe diagnostics travel into the fallback JSON
+    via the re-exec env (``probe_diag`` in detail)."""
     if os.environ.get("NOMAD_TPU_BENCH_FALLBACK"):
         return True
-    from nomad_tpu.utils.backend import cpu_fallback_env, probe_device_count
+    from nomad_tpu.utils.backend import cpu_fallback_env, probe_device_count_cached
 
-    diag = []
-    for i in range(attempts):
-        t0 = time.time()
-        n = probe_device_count(timeout_s)
-        took = round(time.time() - t0, 1)
-        if n > 0:
-            return False
-        diag.append({"attempt": i + 1, "timeout_s": timeout_s, "took_s": took})
-        print(
-            f"bench: backend probe attempt {i + 1}/{attempts} timed out",
-            file=sys.stderr,
-        )
-        if i < attempts - 1:
-            time.sleep(30)  # give a flapping tunnel a chance to recover
+    n, diag = probe_device_count_cached(timeout_s=timeout_s)
+    if n > 0:
+        return False
+    print(
+        f"bench: backend probe negative (cached={diag.get('cached')}), "
+        f"re-exec on CPU backend",
+        file=sys.stderr,
+    )
     env = cpu_fallback_env()
     env["NOMAD_TPU_BENCH_FALLBACK"] = "1"
-    env["NOMAD_TPU_BENCH_FALLBACK_DIAG"] = json.dumps(diag)
+    env["NOMAD_TPU_BENCH_FALLBACK_DIAG"] = json.dumps([diag])
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
     return True  # unreachable; execve does not return
 
@@ -159,6 +156,40 @@ def bench_kernel(n_nodes: int, n_jobs: int, count: int) -> dict:
         "total": n_jobs * count,
         "elapsed_s": round(elapsed, 4),
         "allocs_per_sec": round(placed / elapsed, 1) if elapsed > 0 else 0.0,
+    }
+
+
+def bench_degraded(n_nodes: int = 1_000, n_jobs: int = 8, count: int = 250) -> dict:
+    """Kernel throughput with every breaker forced open: the whole pass
+    routes through the eager CPU/reference scoring path (what the cluster
+    sustains while a tripped kernel waits out its probe backoff). The
+    delta vs the jitted headline is the cost of degraded mode, measured
+    on a deliberately small shape so it doesn't dominate bench runtime."""
+    from nomad_tpu.device.score import PlacementKernel
+    from nomad_tpu.resilience.breaker import set_forced_open
+    from nomad_tpu.utils.metrics import global_metrics
+
+    ct = build_cluster(n_nodes)
+    asks = build_asks(ct, n_jobs, count)
+    kernel = PlacementKernel("binpack")
+    kernel.place(ct, asks)  # warm the jitted path first (fair baseline)
+    set_forced_open(True)
+    try:
+        t0 = time.perf_counter()
+        results = kernel.place(ct, asks)
+        elapsed = time.perf_counter() - t0
+    finally:
+        set_forced_open(False)
+    placed = sum(int((r.node_rows >= 0).sum()) for r in results)
+    snap = global_metrics.snapshot()["counters"]
+    return {
+        "mode": "breakers forced open -> eager reference path",
+        "placed": placed,
+        "total": n_jobs * count,
+        "elapsed_s": round(elapsed, 4),
+        "allocs_per_sec": round(placed / elapsed, 1) if elapsed > 0 else 0.0,
+        "fallback_calls": int(snap.get("nomad.resilience.fallback_calls", 0)),
+        "fallback_passes": int(snap.get("nomad.resilience.fallback_passes", 0)),
     }
 
 
@@ -565,6 +596,7 @@ def main():
     e2e = bench_end_to_end(
         n_nodes, n_jobs, max(count // 4, 10)
     )
+    degraded = bench_degraded()
 
     per_chip_target = 100_000 / 8.0  # north-star share for one v5e chip
     allocs_per_sec = kernel["allocs_per_sec"]
@@ -605,6 +637,9 @@ def main():
                         "18.9-21.4k after restoring multiple-of-16 "
                         "J buckets"
                     ),
+                    # allocs/s with every breaker forced open (the
+                    # reference-path floor a tripped cluster degrades to)
+                    "degraded_mode": degraded,
                     "probe_diag": _fallback_diag(),
                 },
             }

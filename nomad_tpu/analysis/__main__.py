@@ -26,7 +26,7 @@ from . import lint
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m nomad_tpu.analysis",
-        description="repo-specific static analysis (NTA001-NTA008)",
+        description="repo-specific static analysis (NTA001-NTA009)",
     )
     p.add_argument(
         "paths", nargs="*",

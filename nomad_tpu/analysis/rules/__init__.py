@@ -10,6 +10,7 @@ from .lockfields import LockDiscipline  # noqa: E402
 from .spans import SpanCoverage  # noqa: E402
 from .mergedsubmit import MergedSubmitDiscipline  # noqa: E402
 from .wallclock import BareWallClockInBrokerServer  # noqa: E402
+from .blocking import BlockingWithoutTimeout  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -20,6 +21,7 @@ REGISTRY = [
     SpanCoverage,  # NTA006
     MergedSubmitDiscipline,  # NTA007
     BareWallClockInBrokerServer,  # NTA008
+    BlockingWithoutTimeout,  # NTA009
 ]
 
 __all__ = ["REGISTRY"]

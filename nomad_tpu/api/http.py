@@ -219,6 +219,11 @@ class HTTPAgent:
                 re.compile(r"^/v1/agent/trace/(?P<eval_id>[^/]+)$"),
                 self.handle_agent_trace,
             ),
+            (
+                # resilience surface: breaker states + recent trips
+                re.compile(r"^/v1/agent/resilience$"),
+                self.handle_agent_resilience,
+            ),
             (re.compile(r"^/v1/status/leader$"), self.handle_leader),
             (re.compile(r"^/v1/metrics$"), self.handle_metrics),
             (re.compile(r"^/v1/acl/bootstrap$"), self.handle_acl_bootstrap),
@@ -1406,6 +1411,33 @@ class HTTPAgent:
             "traces": flight_recorder.list(int(query.get("n", 50))),
             "errors": flight_recorder.errors(),
             "kernels": kernel_profile(),
+        }
+
+    def handle_agent_resilience(self, method, body, query):
+        """/v1/agent/resilience — per-kernel circuit-breaker snapshots,
+        the forced-open override, recent trip events from the flight
+        recorder, and the resilience counter slice of the metrics
+        registry (``nomad-tpu resilience status`` reads this)."""
+        self._enforce(query, "agent_read")
+        from ..obs.recorder import flight_recorder
+        from ..resilience.breaker import forced_open, snapshot_all
+        from ..utils.metrics import global_metrics
+
+        counters = global_metrics.snapshot()["counters"]
+        return {
+            "breakers": snapshot_all(),
+            "forced_open": forced_open(),
+            "recent_trips": [
+                e
+                for e in flight_recorder.errors()
+                if e.get("component") == "resilience"
+            ],
+            "counters": {
+                k: v
+                for k, v in counters.items()
+                if k.startswith("nomad.resilience.")
+                or k == "nomad.broker.nack_redelivery_delayed"
+            },
         }
 
     # -- ACL endpoints (nomad/acl_endpoint.go) -----------------------------

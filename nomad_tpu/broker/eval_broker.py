@@ -407,9 +407,19 @@ class EvalBroker:
             # deferred evals must not be stranded behind it
             self._promote_pending_locked(job_key)
         else:
-            delay = (
-                self.initial_nack_delay if count <= 1 else self.nack_delay
+            # attempt-indexed escalation: first redelivery waits
+            # initial_nack_delay, each further one doubles, capped at
+            # nack_delay — a hot-looping eval (processing-deadline
+            # expiry, flapping device) cannot spin dequeue/nack at full
+            # broker speed (eval_broker.go computes the same
+            # per-attempt wait before re-enqueueing)
+            delay = min(
+                self.nack_delay,
+                self.initial_nack_delay * (2.0 ** max(0, count - 1)),
             )
+            from ..utils.metrics import global_metrics
+
+            global_metrics.incr("nomad.broker.nack_redelivery_delayed")
             heapq.heappush(
                 self._delayed,
                 (self._clock() + delay, next(self._seq), ev),

@@ -41,7 +41,11 @@ from __future__ import annotations
 from typing import Optional
 
 from ..structs import allocs_fit
-from ..structs.evaluation import EVAL_STATUS_BLOCKED, EVAL_STATUS_PENDING
+from ..structs.evaluation import (
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_FAILED,
+    EVAL_STATUS_PENDING,
+)
 
 INVARIANTS = (
     "node_capacity",
@@ -272,8 +276,12 @@ def check_cluster(
         ]
         if len(live) == desired:
             continue
+        # failed is terminal parking like the broker's failed queue: a
+        # deadline-capped eval explains its job's shortfall the same way
+        # a delivery-limit-capped one does
         accounted = any(
-            ev.status in (EVAL_STATUS_PENDING, EVAL_STATUS_BLOCKED)
+            ev.status
+            in (EVAL_STATUS_PENDING, EVAL_STATUS_BLOCKED, EVAL_STATUS_FAILED)
             or ev.id in failed_ids
             for ev in snap.evals_by_job(namespace, job_id)
         ) or blocked.get_blocked(namespace, job_id) is not None
@@ -304,10 +312,15 @@ def check_cluster(
             )
 
     # context for the human-facing dump
+    from ..resilience.breaker import snapshot_all
+
+    report.info["breakers"] = snapshot_all()
     report.info["ring_errors"] = len(flight_recorder.errors())
     report.info["counters"] = {
         k: v
         for k, v in global_metrics.snapshot()["counters"].items()
-        if k.startswith("nomad.chaos.") or k.endswith(".swallowed_errors")
+        if k.startswith(("nomad.chaos.", "nomad.resilience."))
+        or k == "nomad.broker.nack_redelivery_delayed"
+        or k.endswith(".swallowed_errors")
     }
     return report

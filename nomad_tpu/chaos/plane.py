@@ -31,6 +31,11 @@ injects a fault:
     step the shared :class:`ChaosClock` offset; components that took
     the injectable clock (broker unack sweep, heartbeat TTLs) see time
     jump.
+``hang``
+    block the site for ``arg`` seconds — a wedged PJRT call or a stuck
+    connection. Unlike ``delay`` (a stall the caller rides out), a hang
+    is scheduled only at sites guarded by a deadline (the kernel
+    watchdog), which must get the caller's thread back.
 
 Schedules are deterministic per (seed, site, call-index), so a re-run
 with the same seed plans — and, for a deterministic workload, fires —
@@ -62,9 +67,11 @@ SITES: dict[str, tuple[str, ...]] = {
     "heartbeat.expiry": ("drop", "delay", "skew"),
     "store.snapshot": ("raise", "delay"),
     "kernel.execute": ("raise", "delay"),
+    "kernel.hang": ("hang",),
+    "rpc.conn_drop": ("drop",),
 }
 
-FAULT_KINDS = ("raise", "delay", "duplicate", "drop", "kill", "skew")
+FAULT_KINDS = ("raise", "delay", "duplicate", "drop", "kill", "skew", "hang")
 
 # Expected effective-call budget per site for a `steps`-op workload,
 # as a fraction of steps (with a floor). Fault indices are sampled
@@ -81,6 +88,8 @@ _HORIZON = {
     "heartbeat.expiry": (0.0, 2),
     "store.snapshot": (0.25, 4),
     "kernel.execute": (0.125, 2),
+    "kernel.hang": (0.125, 2),
+    "rpc.conn_drop": (0.25, 2),
 }
 
 
@@ -185,6 +194,10 @@ def build_schedule(
             arg = 0.0
             if action == "delay":
                 arg = rng.uniform(0.001, 0.025)
+            elif action == "hang":
+                # long enough that any sane kernel deadline fires, short
+                # enough that an abandoned watchdog thread drains fast
+                arg = rng.uniform(0.2, 0.5)
             elif action == "skew":
                 arg = rng.choice((-1.0, 1.0)) * rng.uniform(0.25, 1.5)
             specs.append(FaultSpec(site, index, action, arg))
@@ -245,6 +258,9 @@ class FaultPlane:
         if action == "delay":
             self._sleep(spec.arg)
             return "delay"
+        if action == "hang":
+            self._sleep(spec.arg)
+            return "hang"
         if action == "skew":
             self.clock.skew(spec.arg)
             return "skew"

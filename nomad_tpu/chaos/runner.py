@@ -238,6 +238,20 @@ def run_chaos(
         seed=seed, steps=steps, faults=faults, rate=rate, schedule=schedule
     )
     baseline = metrics_baseline()
+    # breaker deadlines scaled like the broker deadlines above: injected
+    # kernel hangs run 0.2-0.5 s, so a 0.1 s execute deadline trips on
+    # the first hang (≤3-consecutive-failures acceptance bound) while
+    # legitimate executes at this cluster size stay sub-millisecond;
+    # compile still gets the full production allowance via the
+    # trace-started probe
+    from ..resilience import breaker as _breaker
+
+    _breaker.reset_all()
+    _prev_breaker = _breaker.configure(
+        execute_deadline=0.1,
+        backoff_base=0.05,
+        backoff_cap=0.25,
+    )
     t_start = time.perf_counter()
     server = Server(
         ServerConfig(
@@ -284,6 +298,8 @@ def run_chaos(
             from ..utils.metrics import count_swallowed
 
             count_swallowed("chaos", None)
+        _breaker.configure(**_prev_breaker)
+        _breaker.reset_all()
     return ChaosRun(
         seed=seed,
         steps=steps,

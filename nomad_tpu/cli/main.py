@@ -660,6 +660,48 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_resilience_status(args) -> int:
+    """`nomad-tpu resilience status` — per-kernel circuit-breaker
+    states, the forced-open override, recent trip events, and the
+    resilience counters (/v1/agent/resilience)."""
+    c = _client(args)
+    try:
+        out = c._request("GET", "/v1/agent/resilience")
+    except APIException as e:
+        return _fail(str(e))
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    breakers = out.get("breakers", {})
+    if out.get("forced_open"):
+        print("forced open: ALL kernels routed to the reference path")
+    if not breakers:
+        print("no kernel breakers registered (no kernel has run yet)")
+    for name in sorted(breakers):
+        b = breakers[name]
+        extra = ""
+        if b["state"] != "closed":
+            extra = (
+                f"  probe_in={b.get('probe_in_s', 0.0):.1f}s"
+                f"  last_error={b.get('last_error') or '-'}"
+            )
+        print(
+            f"{name:<40} {b['state']:<9} trips={b['trips']:<3} "
+            f"consecutive_failures={b['consecutive_failures']}{extra}"
+        )
+    trips = out.get("recent_trips", [])
+    if trips:
+        print(f"\n{len(trips)} recent trip event(s):")
+        for ev in trips[:10]:
+            print(f"  [{ev['component']}] {ev['error']}")
+    counters = out.get("counters", {})
+    if counters:
+        print("\ncounters:")
+        for k in sorted(counters):
+            print(f"  {k} = {counters[k]}")
+    return 0
+
+
 def cmd_scaling_policies(args) -> int:
     """`nomad scaling policy list` (command/scaling_policy_list.go)."""
     c = _client(args)
@@ -1150,6 +1192,13 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("eval_id", nargs="?", default="")
     tr.add_argument("-json", action="store_true")
     tr.set_defaults(fn=cmd_trace)
+
+    res = sub.add_parser(
+        "resilience", help="circuit-breaker / degraded-mode status"
+    ).add_subparsers(dest="res_cmd", required=True)
+    rstat = res.add_parser("status")
+    rstat.add_argument("-json", action="store_true")
+    rstat.set_defaults(fn=cmd_resilience_status)
 
     ver = sub.add_parser("version", help="show version")
     ver.set_defaults(fn=cmd_version)

@@ -1043,6 +1043,14 @@ class PlacementKernel:
         instead of stripe-for-stripe."""
         if not asks:
             return []
+        from ..resilience.breaker import degraded
+
+        if degraded():
+            # one tick per scoring pass executed while any kernel breaker
+            # is open / forced open — the pass runs on the reference path
+            from ..utils.metrics import global_metrics as _metrics
+
+            _metrics.incr("nomad.resilience.fallback_passes")
         used0 = (
             np.asarray(cluster.used)
             if used_override is None

@@ -268,8 +268,12 @@ class RaftNode:
     def _client(self, peer_id: str) -> RPCClient:
         c = self._clients.get(peer_id)
         if c is None:
+            # raft supplies its own retry cadence (heartbeat interval,
+            # election timer); transport-level dial retries would stall
+            # the timing the protocol depends on
             c = RPCClient(
-                self.config.peers[peer_id], timeout=self.config.rpc_timeout
+                self.config.peers[peer_id], timeout=self.config.rpc_timeout,
+                max_attempts=1,
             )
             self._clients[peer_id] = c
         return c

@@ -1,0 +1,48 @@
+"""nomad_tpu.resilience — unified degradation layer.
+
+Three surfaces keep the scheduler placing allocations when the device
+backend, the transport, or a single pass misbehaves:
+
+- :mod:`breaker` — per-kernel circuit breakers with watchdog deadlines;
+  a tripped kernel transparently runs on the eager CPU/reference path.
+- :mod:`watchdog` — the deadline executor behind the breaker (poisoned
+  worker threads, compile-aware two-stage deadlines).
+- eval-lifecycle deadlines + RPC retry/backoff live at their call
+  sites (``server/worker.py``, ``rpc/client.py``) and share the
+  exception types in :mod:`errors`.
+
+Obs surface: ``nomad.resilience.breaker_state.<kernel>`` gauges,
+``trips_total``, ``fallback_calls``, ``fallback_passes``,
+``rpc.retries``, ``eval.deadline_nacks`` counters; breaker trips land
+in the flight recorder (``nomad-tpu resilience status``).
+"""
+
+from .breaker import (
+    CircuitBreaker,
+    all_breakers,
+    breaker_for,
+    configure,
+    degraded,
+    forced_open,
+    reset_all,
+    set_forced_open,
+    snapshot_all,
+)
+from .errors import EvalDeadlineExceeded, KernelDeadlineExceeded
+from .watchdog import DeadlineExecutor, global_executor
+
+__all__ = [
+    "CircuitBreaker",
+    "DeadlineExecutor",
+    "EvalDeadlineExceeded",
+    "KernelDeadlineExceeded",
+    "all_breakers",
+    "breaker_for",
+    "configure",
+    "degraded",
+    "forced_open",
+    "global_executor",
+    "reset_all",
+    "set_forced_open",
+    "snapshot_all",
+]

@@ -55,13 +55,31 @@ class ServerConfig:
         data_dir: Optional[str] = None,
         num_batch_workers: int = 1,
         clock=None,
+        eval_deadline: Optional[float] = None,
+        eval_attempt_limit: Optional[int] = None,
     ):
+        import os
+
         self.num_workers = num_workers
         self.region = region
         self.heartbeat_ttl = heartbeat_ttl
         self.deployment_watch_interval = deployment_watch_interval
         self.acl_enabled = acl_enabled
         self.data_dir = data_dir
+        # per-eval processing deadline in the worker (resilience layer):
+        # an eval whose pass outlives this is nacked with escalating
+        # delay; after eval_attempt_limit expiries it is marked failed
+        # with a structured reason. <= 0 disables the deadline.
+        if eval_deadline is None:
+            eval_deadline = float(
+                os.environ.get("NOMAD_TPU_EVAL_DEADLINE", "60")
+            )
+        self.eval_deadline = eval_deadline
+        if eval_attempt_limit is None:
+            eval_attempt_limit = int(
+                os.environ.get("NOMAD_TPU_EVAL_ATTEMPT_LIMIT", "3")
+            )
+        self.eval_attempt_limit = eval_attempt_limit
         # injectable cluster clock: an object with time() and
         # monotonic() (e.g. chaos.ChaosClock). Threaded into the eval
         # broker's delay/unack deadlines and the heartbeater's TTL

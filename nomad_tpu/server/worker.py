@@ -33,8 +33,10 @@ import numpy as np
 
 from ..chaos.plane import ChaosThreadKill, chaos_site
 from ..obs.trace import global_tracer as tracer
+from ..resilience.errors import EvalDeadlineExceeded
 from ..scheduler import new_scheduler
 from ..structs import Evaluation, MergedPlan, Plan
+from ..structs.evaluation import EVAL_STATUS_FAILED
 from ..utils.metrics import count_swallowed
 from ..utils.metrics import global_metrics as metrics
 
@@ -107,8 +109,22 @@ class _TokenPlanner:
         # when the commit thread sets this, eval writes buffer for a
         # batch-wide flush instead of raft-applying one at a time
         self.buffer: Optional[_EvalBuffer] = None
+        # absolute processing deadline (worker clock) set at dequeue by
+        # Worker._planner; None = no deadline (direct callers)
+        self.deadline: Optional[float] = None
+
+    def check_deadline(self, eval_id: str = "") -> None:
+        """Raise EvalDeadlineExceeded once this eval's processing pass
+        has outlived the server's eval_deadline — checked at the plan
+        submission boundary and before each commit-thread build, the
+        two places a pass commits to more expensive work."""
+        if self.deadline is not None and self._worker._clock() > self.deadline:
+            raise EvalDeadlineExceeded(
+                eval_id, self._worker._eval_deadline or 0.0
+            )
 
     def submit_plan(self, plan: Plan):
+        self.check_deadline(plan.eval_id)
         plan.eval_token = self.token
         plan.normalize()
         server = self._worker.server
@@ -148,6 +164,12 @@ class _TokenPlanner:
 
 
 class Worker:
+    # class-level defaults so partially-constructed workers (tests build
+    # them via __new__) still plan without an eval deadline
+    _eval_deadline: Optional[float] = None
+    _eval_attempt_limit: int = 3
+    _clock = staticmethod(time.time)
+
     def __init__(self, server, worker_id: int = 0, schedulers=None):
         self.server = server
         self.id = worker_id
@@ -164,6 +186,22 @@ class Worker:
         # SERVER-SHARED overlay (server/overlay.py) so concurrent
         # batching workers see each other's in-flight placements too.
         self._commit_thread: Optional[threading.Thread] = None
+        # eval-lifecycle deadlines (resilience layer): the injectable
+        # cluster clock when configured, else wall time
+        cfg = getattr(server, "config", None)
+        clock = getattr(cfg, "clock", None)
+        self._clock = clock.time if clock is not None else time.time
+        deadline = getattr(cfg, "eval_deadline", 0.0) or 0.0
+        self._eval_deadline: Optional[float] = (
+            deadline if deadline > 0 else None
+        )
+        self._eval_attempt_limit: int = getattr(cfg, "eval_attempt_limit", 3)
+
+    def _planner(self, token: str) -> _TokenPlanner:
+        p = _TokenPlanner(self, token)
+        if self._eval_deadline is not None:
+            p.deadline = self._clock() + self._eval_deadline
+        return p
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -272,7 +310,7 @@ class Worker:
         self._join_commit()
 
     def _run_one(self, ev: Evaluation, token: str) -> None:
-        planner = _TokenPlanner(self, token)
+        planner = self._planner(token)
         # idempotent: run() already opened the trace for dequeued evals;
         # this covers direct callers (tests, batch single-path fallbacks
         # keep appending to the tree they started in)
@@ -283,6 +321,9 @@ class Worker:
             self.server.eval_broker.ack(ev.id, token)
             self._bump("acked")
             tracer.finish(ev.id, status="acked")
+        except EvalDeadlineExceeded as e:
+            self._deadline_nack(ev, token, e)
+            return  # _deadline_nack did all the accounting
         except Exception as e:
             log.exception("worker %d: eval %s failed", self.id, ev.id)
             count_swallowed("worker", e)
@@ -345,7 +386,7 @@ class Worker:
             sched = new_scheduler(
                 ev.type,
                 snapshot,
-                _TokenPlanner(self, token),
+                self._planner(token),
                 cache=self.server.device_cache,
                 overlay=self.server.placement_overlay,
             )
@@ -497,6 +538,9 @@ class Worker:
             self.server.placement_overlay.commit_finished()
 
     def _nack_member(self, ev, token, e, what: str) -> None:
+        if isinstance(e, EvalDeadlineExceeded):
+            self._deadline_nack(ev, token, e)
+            return
         log.exception("worker %d: %s %s", self.id, what, ev.id)
         count_swallowed("worker", e)
         try:
@@ -506,6 +550,48 @@ class Worker:
         self._bump("nacked", "processed")
         metrics.incr("nomad.worker.evals_processed")
         tracer.finish(ev.id, status="nacked", error=repr(e))
+
+    def _deadline_nack(self, ev, token, e) -> None:
+        """Escalation path for a processing-deadline expiry. Below the
+        attempt cap: nack — the broker re-enqueues with attempt-indexed
+        delay. At the cap: mark the eval failed with a structured
+        reason (durable BEFORE the ack releases the per-job gate) and
+        ack — terminal parking, not another spin of the hot loop."""
+        ev.attempts += 1
+        limit = self._eval_attempt_limit
+        log.warning(
+            "worker %d: eval %s blew its %ss processing deadline "
+            "(attempt %d/%d)",
+            self.id, ev.id, self._eval_deadline, ev.attempts, limit,
+        )
+        metrics.incr("nomad.resilience.eval.deadline_nacks")
+        count_swallowed("worker", e)
+        if ev.attempts >= limit:
+            ev.status = EVAL_STATUS_FAILED
+            ev.status_description = (
+                f"eval-deadline-exceeded: attempts={ev.attempts} "
+                f"limit={limit} deadline_s={self._eval_deadline}"
+            )
+            try:
+                self.server.apply_eval_update([ev])
+            except Exception as e2:
+                count_swallowed("worker", e2)
+            try:
+                self.server.eval_broker.ack(ev.id, token)
+            except ValueError as e2:
+                count_swallowed("worker", e2)
+            self._bump("processed")
+            metrics.incr("nomad.worker.evals_processed")
+            metrics.incr("nomad.resilience.eval.deadline_failed")
+            tracer.finish(ev.id, status="failed", error=repr(e))
+        else:
+            try:
+                self.server.eval_broker.nack(ev.id, token)
+            except ValueError as e2:
+                count_swallowed("worker", e2)
+            self._bump("nacked", "processed")
+            metrics.incr("nomad.worker.evals_processed")
+            tracer.finish(ev.id, status="nacked", error=repr(e))
 
     def _commit_batch_inner(
         self, prepared, all_asks, results, lane_ok, singles
@@ -542,6 +628,10 @@ class Worker:
                     # adopt this eval's trace on the commit thread so the
                     # spans recorded below parent into it
                     with tracer.activate(ev.id):
+                        # a member whose pass outlived the eval deadline
+                        # escalates (nack w/ delay, then failed) instead
+                        # of committing stale work
+                        sched.planner.check_deadline(ev.id)
                         member = sched.build_batch_plan(span)
                 except Exception as e:  # nta: allow=NTA003 — _nack_member logs+counts
                     self._nack_member(ev, token, e, "batch build")

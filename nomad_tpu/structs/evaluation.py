@@ -83,6 +83,10 @@ class Evaluation:
     annotate_plan: bool = False
     queued_allocations: dict[str, int] = field(default_factory=dict)
     leader_acl: str = ""
+    # worker processing-deadline expiries survived so far (resilience
+    # layer); at the server's eval_attempt_limit the eval is marked
+    # failed with a structured status_description instead of re-nacked
+    attempts: int = 0
     snapshot_index: int = 0
     create_index: int = 0
     modify_index: int = 0

@@ -176,17 +176,104 @@ def traced_jit(fn=None, *, trace_name=None, retrace_budget=None, **jit_kwargs):
 
     jitted = jax.jit(_counted, **jit_kwargs)
     short = name.rsplit(".", 1)[-1]
+    watchdog_on = os.environ.get("NOMAD_TPU_KERNEL_WATCHDOG", "1") != "0"
+
+    def _reference_call(args, kwargs):
+        """The exact CPU/reference path: the ORIGINAL un-jitted body,
+        op by op, inputs pulled to host and computation pinned to the
+        CPU backend so a sick device is never consulted. Eager jax ops
+        and the jitted program compute the same values; with the whole
+        pass on this path the placements are byte-identical to a
+        from-scratch CPU run."""
+        from .metrics import global_metrics
+
+        t0 = time.perf_counter()
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except Exception:
+            cpu = None
+
+        def _host(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype") and hasattr(
+                x, "__array__"
+            ):
+                try:
+                    import numpy as np
+
+                    return np.asarray(x)
+                except Exception:
+                    return x
+            return x
+
+        args = tuple(_host(a) for a in args)
+        kwargs = {k: _host(v) for k, v in kwargs.items()}
+        if cpu is not None:
+            with jax.default_device(cpu):
+                out = fn(*args, **kwargs)
+        else:
+            out = fn(*args, **kwargs)
+        global_metrics.incr("nomad.resilience.fallback_calls")
+        global_metrics.measure(
+            f"nomad.kernel.{short}.fallback", time.perf_counter() - t0
+        )
+        return out
 
     @functools.wraps(fn)
     def _profiled(*args, **kwargs):
         from ..chaos.plane import chaos_site
+        from ..resilience.breaker import breaker_for
+        from ..resilience.errors import KernelDeadlineExceeded
 
+        # nested kernel: when an outer traced_jit kernel is being traced
+        # and calls this one, the args are tracers bound to the caller's
+        # thread-local trace — shipping them to the watchdog thread leaks
+        # them. The outer call's breaker/watchdog already covers the
+        # whole fused computation, so just inline.
+        if not jax.core.trace_state_clean():
+            return jitted(*args, **kwargs)
+        br = breaker_for(name)
+        if not br.allow():
+            return _reference_call(args, kwargs)
         # a raise here models a device-side failure (OOM, preempted
         # TPU); the worker's batch path falls back to single-eval runs
-        chaos_site("kernel.execute")
+        try:
+            chaos_site("kernel.execute")
+        except Exception as e:
+            br.record_failure(e)
+            raise
         before = _trace_counts.get(name, 0)
+
+        def _thunk():
+            # a hang here models a wedged PJRT call — only the watchdog
+            # deadline gets the caller's thread back
+            chaos_site("kernel.hang")
+            return jitted(*args, **kwargs)
+
         t0 = time.perf_counter()
-        out = jitted(*args, **kwargs)
+        try:
+            if watchdog_on and br.execute_deadline > 0:
+                from ..resilience.watchdog import global_executor
+
+                out = global_executor.run(
+                    _thunk,
+                    name=name,
+                    deadline_s=br.execute_deadline,
+                    extend_deadline_s=br.compile_deadline,
+                    extend_probe=(
+                        lambda: _trace_counts.get(name, 0) > before
+                    ),
+                )
+            else:
+                out = _thunk()
+        except KernelDeadlineExceeded as e:
+            br.record_timeout(e)
+            # finish THIS call on the reference path: a mid-batch trip
+            # must not fail sibling members of the merged commit
+            return _reference_call(args, kwargs)
+        except Exception as e:
+            br.record_failure(e)
+            raise
+        br.record_success()
         dt = time.perf_counter() - t0
         _record_kernel_call(name, short, dt, _trace_counts.get(name, 0) > before)
         return out
@@ -213,6 +300,59 @@ def probe_device_count(timeout_s: float = 90.0) -> int:
     t.start()
     t.join(timeout_s)
     return found[0] if found else 0
+
+
+def probe_device_count_cached(
+    timeout_s: float = 90.0,
+    cache_path: str | None = None,
+    ttl_s: float = 300.0,
+) -> tuple[int, dict]:
+    """One probe per process *family*: a dead backend's negative result
+    is cached in the file named by ``NOMAD_TPU_BACKEND_PROBE_CACHE`` (or
+    ``cache_path``), so follow-on processes within ``ttl_s`` skip
+    straight to CPU fallback instead of each paying another timeout.
+    A live probe result removes the cache entry. Returns
+    ``(devices, diag)`` — bench emits ``diag`` as ``probe_diag``."""
+    import json as _json
+
+    if cache_path is None:
+        cache_path = os.environ.get("NOMAD_TPU_BACKEND_PROBE_CACHE", "")
+    diag: dict = {
+        "timeout_s": timeout_s,
+        "cached": False,
+        "cache_path": cache_path or None,
+    }
+    if cache_path and os.path.exists(cache_path):
+        try:
+            with open(cache_path) as f:
+                entry = _json.load(f)
+            age = time.time() - float(entry.get("at_unix", 0))
+            if entry.get("devices", 1) == 0 and 0 <= age < ttl_s:
+                diag.update(
+                    cached=True, devices=0,
+                    cache_age_s=round(age, 1), took_s=0.0,
+                )
+                return 0, diag
+        except (OSError, ValueError, TypeError):
+            pass
+    t0 = time.monotonic()
+    n = probe_device_count(timeout_s)
+    took = time.monotonic() - t0
+    diag.update(devices=n, took_s=round(took, 2))
+    if cache_path:
+        try:
+            if n == 0:
+                with open(cache_path, "w") as f:
+                    _json.dump(
+                        {"devices": 0, "at_unix": time.time(),
+                         "took_s": round(took, 2)},
+                        f,
+                    )
+            elif os.path.exists(cache_path):
+                os.unlink(cache_path)
+        except OSError:
+            pass
+    return n, diag
 
 
 def cpu_fallback_env(n_devices: int | None = None) -> dict:
