@@ -11,6 +11,7 @@ from .spans import SpanCoverage  # noqa: E402
 from .mergedsubmit import MergedSubmitDiscipline  # noqa: E402
 from .wallclock import BareWallClockInBrokerServer  # noqa: E402
 from .blocking import BlockingWithoutTimeout  # noqa: E402
+from .laneowner import LaneOwnerDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -22,6 +23,7 @@ REGISTRY = [
     MergedSubmitDiscipline,  # NTA007
     BareWallClockInBrokerServer,  # NTA008
     BlockingWithoutTimeout,  # NTA009
+    LaneOwnerDiscipline,  # NTA010
 ]
 
 __all__ = ["REGISTRY"]

@@ -1424,6 +1424,7 @@ class HTTPAgent:
         from ..utils.metrics import global_metrics
 
         counters = global_metrics.snapshot()["counters"]
+        srv = self.server
         return {
             "breakers": snapshot_all(),
             "forced_open": forced_open(),
@@ -1432,10 +1433,23 @@ class HTTPAgent:
                 for e in flight_recorder.errors()
                 if e.get("component") == "resilience"
             ],
+            "lanes": {
+                "lane_mode": srv.lane_mode,
+                "num_lanes": srv.lanes.num_lanes,
+                "num_batch_workers": srv.lanes.num_batch_workers,
+                "assignments": {
+                    str(w): list(ls)
+                    for w, ls in srv.lanes.assignments().items()
+                },
+                "claims": srv.lane_claims.snapshot(),
+            },
             "counters": {
                 k: v
                 for k, v in counters.items()
                 if k.startswith("nomad.resilience.")
+                or k.startswith("nomad.plan.lane_")
+                or k.startswith("nomad.worker.lane_")
+                or k == "nomad.plan.cross_lane_handoffs"
                 or k == "nomad.broker.nack_redelivery_delayed"
             },
         }

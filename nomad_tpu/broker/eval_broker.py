@@ -217,10 +217,15 @@ class EvalBroker:
         return f"{ev.type}#p{part}"
 
     def _scan_keys(
-        self, schedulers: list[str], partition: Optional[int]
+        self, schedulers: list[str], partition
     ) -> list[str]:
+        """``partition`` may be None (scan everything), a single int, or
+        a tuple/list of ints — lane mode hands each batching worker its
+        owned lane SET so dequeue is lane-affine by construction."""
         if self.n_partitions == 1:
             return list(schedulers)
+        if isinstance(partition, int):
+            partition = (partition,)
         keys = []
         for t in schedulers:
             if t == FAILED_QUEUE:
@@ -230,22 +235,24 @@ class EvalBroker:
                     f"{t}#p{p}" for p in range(self.n_partitions)
                 )
             else:
-                keys.append(f"{t}#p{partition % self.n_partitions}")
+                keys.extend(
+                    f"{t}#p{p % self.n_partitions}" for p in partition
+                )
         return keys
 
     def dequeue(
         self,
         schedulers: list[str],
         timeout: Optional[float] = None,
-        partition: Optional[int] = None,
+        partition: Optional[int | tuple[int, ...]] = None,
     ) -> tuple[Optional[Evaluation], str]:
         """Blocking dequeue for the given scheduler types. Returns
         (eval, token) or (None, "") on timeout/disable. ``timeout=None``
         blocks until an eval arrives (the reference's blocking
         Eval.Dequeue RPC, nomad/eval_broker.go); ``timeout=0`` is an
         explicit non-blocking poll. ``partition`` restricts the scan to
-        one job-hash partition (concurrent batching workers); None scans
-        every partition."""
+        one job-hash partition, or a lane set when given a tuple
+        (deterministic lane ownership); None scans every partition."""
         deadline = None if timeout is None else self._clock() + timeout
         keys = self._scan_keys(schedulers, partition)
         with self._lock:
@@ -314,7 +321,7 @@ class EvalBroker:
         schedulers: list[str],
         max_n: int,
         timeout: Optional[float] = None,
-        partition: Optional[int] = None,
+        partition: Optional[int | tuple[int, ...]] = None,
     ) -> list[tuple[Evaluation, str]]:
         """Dequeue up to ``max_n`` ready evals in one call — the intake of
         the batched multi-eval device pass (SURVEY.md §7 step 5). The

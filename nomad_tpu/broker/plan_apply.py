@@ -33,7 +33,16 @@ from ..structs import (
     allocs_fit,
 )
 from ..structs.resources import node_comparable_capacity
-from ..utils.metrics import global_metrics as metrics
+from ..utils.metrics import count_swallowed, global_metrics as metrics
+
+
+class PlanTokenMismatch(Exception):
+    """The plan's broker token is no longer the eval's outstanding token:
+    the unack deadline redelivered the eval mid-commit and another worker
+    owns it now. The stale submitter must drop its plan, not retry —
+    committing both copies would place the job twice (a surplus no
+    remaining eval reconciles). Mirrors the reference's token validation
+    on plan submission (plan_endpoint.go / OutstandingReset)."""
 
 
 def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
@@ -392,17 +401,90 @@ class PlanApplier:
     broker enqueue."""
 
     def __init__(self, store, on_evals_created=None, commit=None,
-                 commit_merged=None):
+                 commit_merged=None, lanes=None, token_check=None):
         self.store = store
         self.on_evals_created = on_evals_created
         self.commit = commit
         self.commit_merged = commit_merged
+        # LaneMap when deterministic lane ownership is active: merged
+        # plans then carry an owner_worker and the applier ASSERTS lane
+        # disjointness instead of discovering conflicts optimistically
+        self.lanes = lanes
+        # callable(eval_id, token) -> bool: is the token still the
+        # eval's CURRENT outstanding broker token? The reference's
+        # submission guard (plan_endpoint.go token validation): once the
+        # unack deadline redelivers an eval, the original worker's plan
+        # must not commit — two workers racing one redelivered eval
+        # would otherwise both place it (committed surplus with no eval
+        # left to reconcile it). None (or an empty plan token) skips the
+        # check — direct callers and tests submit outside the broker.
+        self.token_check = token_check
         self._lock = threading.Lock()
+
+    def _token_stale(self, plan) -> bool:
+        token = getattr(plan, "eval_token", "")
+        if not token or self.token_check is None:
+            return False
+        if self.token_check(plan.eval_id, token):
+            return False
+        metrics.incr("nomad.plan.stale_token_rejects")
+        return True
+
+    def _check_lane_ownership(self, mplan: MergedPlan) -> None:
+        """The structural assertion lane mode buys us: every node a
+        merged plan places on must belong to the committing worker's
+        lanes or be covered by a confirmed cross-lane claim attached to
+        the plan. Anything else means a worker escaped the lane
+        contract — count it as a lane conflict (invariant law 9 pins the
+        counter at zero) and log through the swallow ledger so the
+        flight recorder sees it; the member still verifies/commits
+        normally (the applier stays the capacity authority)."""
+        claimed = {
+            n for c in mplan.claims
+            if getattr(c, "confirmed", False)
+            for n in c.node_ids()
+        }
+        for plan in mplan.plans:
+            for node_id in plan.node_allocation:
+                owner = self.lanes.owner_of_node(node_id)
+                if owner != mplan.owner_worker and node_id not in claimed:
+                    metrics.incr("nomad.plan.lane_conflicts")
+                    count_swallowed(
+                        "lanes",
+                        AssertionError(
+                            f"merged plan from worker {mplan.owner_worker} "
+                            f"touches node {node_id} (owner w{owner}) "
+                            "without a confirmed cross-lane claim"
+                        ),
+                    )
+
+    def _check_lane_rejections(self, mplan, results) -> None:
+        """Post-verify: a rejected node the committing worker does NOT
+        own means a cross-lane race slipped the claim protocol (a
+        confirmed claim re-checked capacity on a fresh snapshot, so it
+        cannot be bounced for fit). Own-lane rejections stay ordinary
+        optimistic staleness — solo retry, not a lane conflict."""
+        for res in results:
+            for node_id in res.rejected_nodes:
+                if self.lanes.owner_of_node(node_id) != mplan.owner_worker:
+                    metrics.incr("nomad.plan.lane_conflicts")
+                    count_swallowed(
+                        "lanes",
+                        AssertionError(
+                            f"cross-lane rejection on {node_id} for "
+                            f"worker {mplan.owner_worker}"
+                        ),
+                    )
 
     def apply(self, plan: Plan) -> PlanResult:
         with self._lock, tracer.span(
             "plan_apply", timer="nomad.plan.apply"
         ) as sp:
+            if self._token_stale(plan):
+                raise PlanTokenMismatch(
+                    f"eval {plan.eval_id}: broker token rotated before "
+                    "apply (redelivered to another worker)"
+                )
             with tracer.span(
                 "plan_apply.evaluate", timer="nomad.plan.evaluate"
             ):
@@ -465,9 +547,31 @@ class PlanApplier:
         records the timings as shared spans into every member's trace."""
         t_apply = time.perf_counter()
         with self._lock:
+            lane_mode = self.lanes is not None and mplan.owner_worker >= 0
+            if lane_mode:
+                self._check_lane_ownership(mplan)
             t0 = time.perf_counter()
             chaos_site("plan_apply.verify")
-            results = evaluate_merged_plan(self.store, mplan.plans)
+            # stale-token members are excluded BEFORE the union verify:
+            # a redelivered eval's duplicate placements must neither
+            # commit nor consume capacity that would bounce a live
+            # sibling. Their result slot is an empty, flagged no-op so
+            # per-member attribution stays aligned with mplan.plans.
+            stale = [self._token_stale(p) for p in mplan.plans]
+            if any(stale):
+                live_idx = [i for i, s in enumerate(stale) if not s]
+                live = evaluate_merged_plan(
+                    self.store, [mplan.plans[i] for i in live_idx]
+                )
+                results = [
+                    PlanResult(token_stale=True) for _ in mplan.plans
+                ]
+                for i, res in zip(live_idx, live):
+                    results[i] = res
+            else:
+                results = evaluate_merged_plan(self.store, mplan.plans)
+            if lane_mode:
+                self._check_lane_rejections(mplan, results)
             evaluate_s = time.perf_counter() - t0
             metrics.measure("nomad.plan.evaluate", evaluate_s)
             # merged-only sample so the bench can report the batched

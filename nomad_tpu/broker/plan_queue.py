@@ -137,10 +137,12 @@ class PlanApplyLoop:
     """The leader's serialized applier thread (plan_apply.go:71-178)."""
 
     def __init__(self, store, queue: PlanQueue, on_evals_created=None,
-                 commit=None, commit_merged=None):
+                 commit=None, commit_merged=None, lanes=None,
+                 token_check=None):
         self.applier = PlanApplier(
             store, on_evals_created=on_evals_created, commit=commit,
-            commit_merged=commit_merged,
+            commit_merged=commit_merged, lanes=lanes,
+            token_check=token_check,
         )
         self.queue = queue
         self._stop = threading.Event()

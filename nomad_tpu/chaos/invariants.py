@@ -34,6 +34,14 @@ true no matter which faults fired:
     no eval is stranded: every non-terminal eval in the store is still
     tracked somewhere (broker queues, delayed heap, job gate, failed
     queue, or the blocked-evals tracker).
+``lane_isolation``
+    with deterministic lane ownership active, structural disjointness
+    held: zero lane conflicts (``nomad.plan.lane_conflicts`` — a merged
+    plan touching a foreign node without a confirmed claim, or bounced
+    on one), zero cross-lane overlay writes
+    (``nomad.overlay.cross_lane_writes``), and the claim table drained
+    (no leaked reservations after quiesce). Handoffs themselves are
+    fine and counted separately (``nomad.plan.cross_lane_handoffs``).
 """
 
 from __future__ import annotations
@@ -56,6 +64,7 @@ INVARIANTS = (
     "swallow_ring",
     "job_conservation",
     "eval_terminal",
+    "lane_isolation",
 )
 
 
@@ -124,7 +133,14 @@ def metrics_baseline() -> dict:
     swallowed = sum(
         v for k, v in counters.items() if k.endswith(".swallowed_errors")
     )
-    return {"swallowed": swallowed, "ring": flight_recorder.errors_total}
+    return {
+        "swallowed": swallowed,
+        "ring": flight_recorder.errors_total,
+        "lane_conflicts": counters.get("nomad.plan.lane_conflicts", 0),
+        "cross_lane_writes": counters.get(
+            "nomad.overlay.cross_lane_writes", 0
+        ),
+    }
 
 
 def check_cluster(
@@ -205,15 +221,22 @@ def check_cluster(
     overlay = getattr(server, "placement_overlay", None)
     if overlay is not None:
         report.checked["overlay_drained"] = True
-        with overlay._lock:
-            passes, commits = overlay._passes, overlay._commits
-        if passes or commits:
-            report._fail(
-                "overlay_drained",
-                "placement_overlay",
-                f"markers leaked after quiesce: passes={passes} "
-                f"commits={commits}",
-            )
+        if hasattr(overlay, "snapshot_markers"):
+            # LaneOverlays: every per-worker overlay must drain
+            markers = overlay.snapshot_markers()
+            if not isinstance(markers, list):
+                markers = [markers]
+        else:
+            with overlay._lock:
+                markers = [(overlay._passes, overlay._commits)]
+        for w, (passes, commits) in enumerate(markers):
+            if passes or commits:
+                report._fail(
+                    "overlay_drained",
+                    f"placement_overlay[{w}]",
+                    f"markers leaked after quiesce: passes={passes} "
+                    f"commits={commits}",
+                )
 
     # -- broker_conservation -----------------------------------------------
     report.checked["broker_conservation"] = True
@@ -311,6 +334,42 @@ def check_cluster(
                 "tracked by no queue",
             )
 
+    # -- lane_isolation ----------------------------------------------------
+    # Checked whenever the lane machinery exists (it is structural, so
+    # the counters must stay zero even at one worker); the claim-table
+    # drain additionally proves no reservation leaked past quiesce —
+    # including through handoff_drop faults and kill-mid-handoff.
+    claims = getattr(server, "lane_claims", None)
+    if claims is not None:
+        report.checked["lane_isolation"] = True
+        base = baseline or {}
+        d_conflicts = now["lane_conflicts"] - base.get("lane_conflicts", 0)
+        d_xwrites = now["cross_lane_writes"] - base.get(
+            "cross_lane_writes", 0
+        )
+        if d_conflicts:
+            report._fail(
+                "lane_isolation",
+                "plan_applier",
+                f"{d_conflicts} lane conflicts (merged plans escaped "
+                "ownership or bounced on foreign nodes)",
+            )
+        if d_xwrites:
+            report._fail(
+                "lane_isolation",
+                "placement_overlay",
+                f"{d_xwrites} cross-lane overlay writes refused (a worker "
+                "wrote into a peer's epoch)",
+            )
+        if not claims.drained():
+            report._fail(
+                "lane_isolation",
+                "lane_claims",
+                f"{claims.active_count()} claims still active after "
+                f"quiesce (nodes {sorted(claims.blocked_node_ids())})",
+            )
+        report.info["lanes"] = claims.snapshot()
+
     # context for the human-facing dump
     from ..resilience.breaker import snapshot_all
 
@@ -319,7 +378,10 @@ def check_cluster(
     report.info["counters"] = {
         k: v
         for k, v in global_metrics.snapshot()["counters"].items()
-        if k.startswith(("nomad.chaos.", "nomad.resilience."))
+        if k.startswith((
+            "nomad.chaos.", "nomad.resilience.", "nomad.lane.",
+            "nomad.overlay.", "nomad.plan.lane", "nomad.plan.cross_lane",
+        ))
         or k == "nomad.broker.nack_redelivery_delayed"
         or k.endswith(".swallowed_errors")
     }

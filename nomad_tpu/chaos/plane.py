@@ -69,6 +69,11 @@ SITES: dict[str, tuple[str, ...]] = {
     "kernel.execute": ("raise", "delay"),
     "kernel.hang": ("hang",),
     "rpc.conn_drop": ("drop",),
+    # cross-lane handoff protocol (server/lanes.py): a dropped confirm
+    # must release the reservation (no leaked claims), a kill mid-
+    # handoff must still settle/release via the worker's finally
+    "lane.handoff_drop": ("drop", "kill"),
+    "lane.handoff_delay": ("delay",),
 }
 
 FAULT_KINDS = ("raise", "delay", "duplicate", "drop", "kill", "skew", "hang")
@@ -90,6 +95,8 @@ _HORIZON = {
     "kernel.execute": (0.125, 2),
     "kernel.hang": (0.125, 2),
     "rpc.conn_drop": (0.25, 2),
+    "lane.handoff_drop": (0.25, 2),
+    "lane.handoff_delay": (0.25, 2),
 }
 
 

@@ -228,6 +228,7 @@ def run_chaos(
     rate: float = 0.04,
     schedule: Optional[list[FaultSpec]] = None,
     quiesce_timeout: float = 60.0,
+    num_batch_workers: int = 1,
 ) -> ChaosRun:
     """One full chaos cycle: boot, inject, quiesce, check, tear down."""
     from ..obs.recorder import flight_recorder
@@ -255,7 +256,11 @@ def run_chaos(
     t_start = time.perf_counter()
     server = Server(
         ServerConfig(
-            num_workers=1,
+            # every worker batches: the chaos workload is service-only,
+            # and system/_core evals ride the batch workers' singles
+            # path, so solo workers would only add nondeterminism
+            num_workers=num_batch_workers,
+            num_batch_workers=num_batch_workers,
             # heartbeats come from no client here; a real TTL would mark
             # every node down mid-run (heartbeat expiry has its own
             # deterministic unit test — see tests/test_chaos.py)
@@ -285,6 +290,7 @@ def run_chaos(
             quiesced = _quiesce(server, 10.0)
         report = check_cluster(server, plane=plane, baseline=baseline)
         report.info["quiesced"] = quiesced
+        report.info["batch_workers"] = num_batch_workers
         if not quiesced:
             report._fail(
                 "eval_terminal",
@@ -320,6 +326,7 @@ def shrink_schedule(
     nodes: int = DEFAULT_NODES,
     rate: float = 0.04,
     schedule: Optional[list[FaultSpec]] = None,
+    num_batch_workers: int = 1,
     log=None,
 ) -> tuple[list[FaultSpec], Optional[ChaosRun]]:
     """Greedy 1-minimal shrink of a failing schedule: drop one planned
@@ -330,7 +337,8 @@ def shrink_schedule(
         plane = FaultPlane(seed=seed, steps=steps, faults=faults, rate=rate)
         schedule = list(plane.schedule)
     base = run_chaos(
-        seed=seed, steps=steps, faults=faults, nodes=nodes, schedule=schedule
+        seed=seed, steps=steps, faults=faults, nodes=nodes,
+        schedule=schedule, num_batch_workers=num_batch_workers,
     )
     if base.ok:
         return schedule, None
@@ -345,7 +353,8 @@ def shrink_schedule(
                 f"({len(trial)} faults)"
             )
         run = run_chaos(
-            seed=seed, steps=steps, faults=faults, nodes=nodes, schedule=trial
+            seed=seed, steps=steps, faults=faults, nodes=nodes,
+            schedule=trial, num_batch_workers=num_batch_workers,
         )
         if not run.ok:
             current = trial  # still fails without it: drop for good

@@ -694,6 +694,24 @@ def cmd_resilience_status(args) -> int:
         print(f"\n{len(trips)} recent trip event(s):")
         for ev in trips[:10]:
             print(f"  [{ev['component']}] {ev['error']}")
+    lanes = out.get("lanes", {})
+    if lanes.get("lane_mode"):
+        claims = lanes.get("claims", {})
+        print(
+            f"\nlanes: {lanes['num_lanes']} across "
+            f"{lanes['num_batch_workers']} batch worker(s)"
+        )
+        for w in sorted(lanes.get("assignments", {}), key=int):
+            owned = lanes["assignments"][w]
+            print(f"  worker {w}: lanes {','.join(map(str, owned))}")
+        if claims:
+            cc = claims.get("counters", {})
+            print(
+                f"  handoffs: reserves={cc.get('reserves', 0)} "
+                f"confirms={cc.get('confirms', 0)} "
+                f"rejected={cc.get('confirm_rejected', 0)} "
+                f"active={claims.get('active_claims', 0)}"
+            )
     counters = out.get("counters", {})
     if counters:
         print("\ncounters:")
@@ -802,6 +820,7 @@ def cmd_chaos_run(args) -> int:
         faults=faults,
         nodes=args.nodes,
         rate=args.rate,
+        num_batch_workers=args.batch_workers,
     )
     if args.json:
         print(run.canonical_json())
@@ -817,6 +836,7 @@ def cmd_chaos_run(args) -> int:
             faults=faults,
             nodes=args.nodes,
             rate=args.rate,
+            num_batch_workers=args.batch_workers,
             log=lambda m: print(m, file=sys.stderr),
         )
         if fail is None:
@@ -1250,6 +1270,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     crun.add_argument("--json", action="store_true",
                       help="emit the canonical (bit-reproducible) report")
+    crun.add_argument("--batch-workers", type=int, default=1,
+                      help="batching workers for the in-process cluster "
+                      "(lane-partitioned commit path when > 1)")
     crun.add_argument("--verbose", action="store_true",
                       help="include timing-dependent diagnostics")
     crun.add_argument("--shrink", action="store_true",

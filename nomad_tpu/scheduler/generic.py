@@ -84,6 +84,7 @@ class GenericScheduler:
         cache=None,
         overlay=None,
         clock=None,
+        node_filter=None,
     ):
         self.snapshot = snapshot
         self.planner = planner
@@ -104,6 +105,12 @@ class GenericScheduler:
         # retrying — it must score against, and reserve into, the same
         # in-flight accounting as the batched passes
         self.overlay = overlay
+        # optional eligibility restriction: callable(ct) → bool[padded_n]
+        # row mask ANDed into every ask. Lane mode uses it to keep a
+        # batch worker's solo fallback inside its own lanes (a solo
+        # plan has no cross-lane handoff, so foreign nodes are out);
+        # shortfalls become blocked evals, never foreign-node writes.
+        self.node_filter = node_filter
         self.kernel: Optional[PlacementKernel] = None
         self.eval: Optional[Evaluation] = None
         self.job = None
@@ -155,6 +162,10 @@ class GenericScheduler:
         if placements and self.job is not None:
             ct, tg_order = self._build_group_asks(placements)
             asks = [t[3] for t in tg_order]
+            if self.node_filter is not None and asks:
+                mask = self.node_filter(ct)
+                for a in asks:
+                    a.eligible &= mask
             used_override = None
             if self.overlay is not None:
                 used_override = self.overlay.begin_pass(ct)

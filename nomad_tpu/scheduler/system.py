@@ -43,6 +43,8 @@ class SystemScheduler:
         cache=None,
         overlay=None,  # accepted for factory uniformity; system placement
         # is per-node (no greedy packing), so the overlay isn't consulted
+        node_filter=None,  # likewise unused: a system job runs on EVERY
+        # eligible node, so lane restriction would be semantically wrong
     ):
         self.snapshot = snapshot
         self.planner = planner

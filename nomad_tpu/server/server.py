@@ -54,6 +54,8 @@ class ServerConfig:
         acl_enabled: bool = False,
         data_dir: Optional[str] = None,
         num_batch_workers: int = 1,
+        num_lanes: int = 16,
+        lane_mode: Optional[bool] = None,
         clock=None,
         eval_deadline: Optional[float] = None,
         eval_attempt_limit: Optional[int] = None,
@@ -91,6 +93,20 @@ class ServerConfig:
         # evals). >1 needs the broker's partitioned queues so two
         # batched passes never carry the same jobs.
         self.num_batch_workers = max(1, min(num_batch_workers, num_workers or 1))
+        # deterministic lane map size (server/lanes.py). A CONSTANT with
+        # respect to the worker count — placement must be a function of
+        # (job, cluster state) only, so re-running with more workers
+        # yields byte-identical placements — clamped so every batching
+        # worker owns at least one lane.
+        self.num_lanes = max(int(num_lanes), self.num_batch_workers, 1)
+        # lane mode auto-enables with >1 batching worker. The explicit
+        # override exists for the byte-identity harness: a 1-worker
+        # reference run must take the SAME code path (lane-salted batch
+        # passes, lane-partitioned broker) as the N-worker run it is
+        # compared against.
+        self.lane_mode = (
+            self.num_batch_workers > 1 if lane_mode is None else bool(lane_mode)
+        )
 
 
 class Server:
@@ -98,8 +114,21 @@ class Server:
         self.config = config or ServerConfig()
         self.store = StateStore()
         clock = self.config.clock
+        # Deterministic lane ownership (server/lanes.py): active only
+        # with >1 batching worker. The broker then partitions by LANE
+        # (num_lanes sub-queues, same crc32 job hash as LaneMap) so the
+        # partitioned dequeue IS lane-affine routing; at one batching
+        # worker everything stays on the legacy single-queue path,
+        # bit-identical to r5 behavior.
+        from .lanes import LaneClaims, LaneMap
+
+        self.lane_mode = self.config.lane_mode
+        self.lanes = LaneMap(
+            num_lanes=self.config.num_lanes,
+            num_batch_workers=self.config.num_batch_workers,
+        )
         self.eval_broker = EvalBroker(
-            n_partitions=self.config.num_batch_workers,
+            n_partitions=self.lanes.num_lanes if self.lane_mode else 1,
             clock=clock.time if clock is not None else None,
         )
         self.blocked_evals = BlockedEvals(broker=self.eval_broker)
@@ -109,6 +138,8 @@ class Server:
             on_evals_created=self.eval_broker.enqueue_all,
             commit=self._commit_plan_result,
             commit_merged=self._commit_merged_plan_result,
+            lanes=self.lanes if self.lane_mode else None,
+            token_check=self._plan_token_current,
         )
         self.workers: list[Worker] = []
         # resident device tensors shared by all workers, refreshed
@@ -116,11 +147,20 @@ class Server:
         from ..device.cache import DeviceStateCache
 
         self.device_cache = DeviceStateCache()
-        # cross-worker optimistic usage for pipelined batched passes
-        # (server/overlay.py)
-        from .overlay import SharedOverlay
+        # per-worker epoch overlays for pipelined batched passes
+        # (server/overlay.py). In lane mode each batching worker owns
+        # its own overlay — no shared mutable optimistic state; at one
+        # batching worker the container delegates to a single overlay,
+        # preserving the legacy shared behavior bit-for-bit.
+        from .overlay import LaneOverlays
 
-        self.placement_overlay = SharedOverlay()
+        self.placement_overlay = LaneOverlays(self.config.num_batch_workers)
+        # cross-lane handoff table (reserve → confirm → release)
+        self.lane_claims = LaneClaims(
+            self.lanes,
+            overlays=self.placement_overlay,
+            snapshot_fn=self.store.snapshot,
+        )
         self._raft_lock = threading.Lock()
         self._leader = False
         from ..broker.event_broker import EventBroker as StreamBroker
@@ -249,6 +289,13 @@ class Server:
             {"group": group, "count": count},
         )
         return ev
+
+    def _plan_token_current(self, eval_id: str, token: str) -> bool:
+        """Is ``token`` still the eval's outstanding broker token? Used
+        by the plan applier to drop plans from workers whose eval was
+        redelivered out from under them (unack-deadline expiry) — the
+        reference's plan-submission token validation."""
+        return self.eval_broker.outstanding_token(eval_id) == token
 
     def _commit_plan_result(self, result, eval_id, evals) -> int:
         index, _ = self.raft_apply(

@@ -31,6 +31,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..broker.plan_apply import PlanTokenMismatch
 from ..chaos.plane import ChaosThreadKill, chaos_site
 from ..obs.trace import global_tracer as tracer
 from ..resilience.errors import EvalDeadlineExceeded
@@ -203,6 +204,50 @@ class Worker:
             p.deadline = self._clock() + self._eval_deadline
         return p
 
+    # -- lane plumbing -----------------------------------------------------
+    def _lane_mode(self) -> bool:
+        """Deterministic lane ownership is active only with >1 batching
+        worker; at 1 every path below reduces to the legacy behavior."""
+        return getattr(self.server, "lane_mode", False)
+
+    def _my_overlay(self):
+        """This worker's epoch overlay. In lane mode each batching
+        worker scores against (and writes deltas into) its OWN overlay;
+        solo workers — and everything at num_batch_workers=1 — use the
+        legacy shared view (LaneOverlays delegates it to worker 0)."""
+        ov = self.server.placement_overlay
+        for_worker = getattr(ov, "for_worker", None)
+        n_batchers = getattr(self.server.config, "num_batch_workers", 1)
+        if for_worker is not None and n_batchers > 1 and self.id < n_batchers:
+            return for_worker(self.id)
+        return ov
+
+    def _rebase_lanes(self, overlay) -> None:
+        """An overlay epoch reset (or a fresh epoch) means this worker's
+        next snapshot includes every committed cross-lane handoff onto
+        its nodes — unblock them."""
+        claims = getattr(self.server, "lane_claims", None)
+        if claims is not None and self._lane_mode():
+            if overlay.is_fresh():
+                claims.clear_settled(self.id)
+
+    def _lane_node_filter(self, ct) -> np.ndarray:
+        """Eligibility mask for a batch worker's SOLO fallback in lane
+        mode: own lanes only, minus claim-blocked nodes. The batched
+        path scores the full cluster and hands off cross-lane winners;
+        the solo fallback has no handoff step, so it stays home — a
+        shortfall becomes a blocked eval, never a foreign-node write."""
+        claims = self.server.lane_claims
+        blocked = claims.blocked_node_ids()
+        lanes = self.server.lanes
+        mask = np.zeros(ct.padded_n, dtype=bool)
+        for i, node in enumerate(ct.nodes):
+            mask[i] = (
+                lanes.owner_of_node(node.id) == self.id
+                and node.id not in blocked
+            )
+        return mask
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self._stop.clear()
@@ -244,23 +289,45 @@ class Worker:
                 continue
             n_batchers = getattr(self.server.config, "num_batch_workers", 1)
             batching = self.id < n_batchers
+            lane_mode = batching and self._lane_mode()
+            # Lane-affine dequeue: a batching worker scans exactly the
+            # lane set it owns (the broker partitions by the SAME job
+            # hash LaneMap uses, so partition keys ARE lanes); solo
+            # workers scan everything, but in lane mode they must not
+            # steal service/batch evals from their lane owners — they
+            # drain only the solo-native types.
+            scan_types = self.schedulers
+            if self._lane_mode() and not batching:
+                scan_types = [
+                    t for t in self.schedulers
+                    if t not in ("service", "batch")
+                ]
             # pre-trace interval: no eval (hence no trace) exists until the
             # dequeue returns — the sample feeds /v1/metrics directly and
             # the span is attached retroactively per dequeued eval below
             t0 = time.perf_counter()
             batch = self.server.eval_broker.dequeue_many(
-                self.schedulers,
+                scan_types,
                 EVAL_BATCH_SIZE if batching else 1,
                 timeout=0.2,
-                # each batching worker owns one job-hash partition so
-                # two batched passes never share a job set; solo
-                # workers scan every partition
-                partition=self.id if batching and n_batchers > 1 else None,
+                partition=(
+                    self.server.lanes.lanes_of_worker(self.id)
+                    if lane_mode
+                    else None
+                ),
             )
             dequeue_s = time.perf_counter() - t0
             metrics.measure("nomad.worker.dequeue_eval", dequeue_s)
             if not batch:
                 self._join_commit()
+                if lane_mode:
+                    # idle is the rebase point: drop a drained epoch and
+                    # unblock any handoff-settled nodes (the next
+                    # snapshot includes those committed placements)
+                    ov = self._my_overlay()
+                    if ov.maybe_reset():
+                        metrics.incr("nomad.worker.pipeline_epoch_resets")
+                    self._rebase_lanes(ov)
                 continue
             for ev, _token in batch:
                 queue_wait = self.server.eval_broker.take_queue_wait(ev.id)
@@ -286,12 +353,17 @@ class Worker:
                         },
                     )
             try:
-                if len(batch) == 1:
+                if len(batch) == 1 and not lane_mode:
                     # batch accounting reconciliation: evals dequeued solo
                     # never enter a batched pass at all
                     metrics.incr("nomad.worker.solo_evals")
                     self._run_one(*batch[0])
                 else:
+                    # in lane mode even a batch of one goes through the
+                    # batched pass: byte-identity with the 1-worker
+                    # reference requires every service/batch eval to
+                    # take the SAME code path (same salt, same overlay,
+                    # same merged-commit route) regardless of load
                     self._run_batch(batch)
             except Exception as e:
                 # a worker thread must never die silently: dequeued evals
@@ -324,6 +396,16 @@ class Worker:
         except EvalDeadlineExceeded as e:
             self._deadline_nack(ev, token, e)
             return  # _deadline_nack did all the accounting
+        except PlanTokenMismatch:
+            # the unack deadline redelivered this eval mid-flight: the
+            # redelivered copy owns it now. Drop — no ack/nack (our token
+            # is already dead at the broker) and no retry (retrying would
+            # race the new owner into exactly the double-commit the token
+            # guard exists to prevent).
+            metrics.incr("nomad.worker.stale_token_drops")
+            self._bump("processed")
+            tracer.finish(ev.id, status="stale_token")
+            return
         except Exception as e:
             log.exception("worker %d: eval %s failed", self.id, ev.id)
             count_swallowed("worker", e)
@@ -351,8 +433,14 @@ class Worker:
             not self._commit_thread.is_alive()
         ):
             self._join_commit()
-        if self.server.placement_overlay.maybe_reset():
+        overlay = self._my_overlay()
+        if overlay.maybe_reset():
             metrics.incr("nomad.worker.pipeline_epoch_resets")
+        lane_mode = self._lane_mode()
+        if lane_mode:
+            # a fresh epoch rebases this worker onto the committed
+            # store — any nodes settled by peers' handoffs unblock now
+            self._rebase_lanes(overlay)
         t0 = time.perf_counter()
         self.server.store.wait_for_index(
             max(ev.modify_index for ev, _ in batch), timeout=5.0
@@ -388,7 +476,7 @@ class Worker:
                 snapshot,
                 self._planner(token),
                 cache=self.server.device_cache,
-                overlay=self.server.placement_overlay,
+                overlay=overlay,
             )
             t0 = time.perf_counter()
             try:
@@ -411,11 +499,28 @@ class Worker:
         results = None
         lane_ok: list[bool] = []
         if all_asks:
-            # Optimistic overlay: in-flight passes (this worker's AND
-            # other batching workers') are not committed yet, but the
-            # applier WILL land most of them — scoring against bare
-            # ct.used would double-book those nodes (server/overlay.py).
-            overlay = self.server.placement_overlay
+            if lane_mode:
+                # mask out claim-blocked nodes: a peer's handoff is in
+                # flight on them (or their owner has not yet rebased a
+                # committed one) — scoring them would race the claim.
+                # Everything ELSE stays scorable: lane mode scores the
+                # FULL cluster and hands off foreign winners, because
+                # restricting each worker to its own lanes would change
+                # placements vs the 1-worker reference.
+                blocked = self.server.lane_claims.blocked_node_ids()
+                if blocked:
+                    rows = [
+                        ct.node_row[n] for n in blocked if n in ct.node_row
+                    ]
+                    if rows:
+                        for a in all_asks:
+                            a.eligible[rows] = False
+            # Optimistic overlay: in-flight passes of THIS worker's
+            # pipeline are not committed yet, but the applier WILL land
+            # most of them — scoring against bare ct.used would
+            # double-book those nodes (server/overlay.py). In lane mode
+            # this overlay is the worker's own; peers' in-flight state
+            # is irrelevant by construction (disjoint lanes + claims).
             used_override = overlay.begin_pass(ct)
             if used_override is not None:
                 metrics.incr("nomad.worker.pipeline_override_passes")
@@ -425,15 +530,30 @@ class Worker:
                 # decorrelate: each lane scores a disjoint node stripe
                 # (the vector analog of per-worker shuffle sampling,
                 # stack.go:74-90) so concurrent lanes stop argmaxing
-                # onto the same nodes; repair re-scores any remainder
+                # onto the same nodes; repair re-scores any remainder.
+                # The tie-break salt must be a function of the WORK, not
+                # the worker: lane mode derives it from the first eval's
+                # job lane so an N-worker run reproduces the 1-worker
+                # reference byte for byte, and the legacy cross-worker
+                # node-universe carving (decorrelate_workers) is retired
+                # — structural claims replace it.
                 results = kernel.place(
                     ct,
                     all_asks,
                     decorrelate=True,
-                    decorrelate_salt=self.id,
-                    # concurrent batchers carve disjoint node slices
-                    decorrelate_workers=getattr(
-                        self.server.config, "num_batch_workers", 1
+                    decorrelate_salt=(
+                        self.server.lanes.lane_of_job(
+                            prepared[0][0].namespace, prepared[0][0].job_id
+                        )
+                        if lane_mode
+                        else self.id
+                    ),
+                    decorrelate_workers=(
+                        1
+                        if lane_mode
+                        else getattr(
+                            self.server.config, "num_batch_workers", 1
+                        )
                     ),
                     overflow=32,
                     used_override=used_override,
@@ -494,10 +614,12 @@ class Worker:
                                 rows = results[lane].node_rows
                                 rows = rows[rows >= 0]
                                 if rows.size:
-                                    overlay.add_delta(ct, rows, a.ask)
+                                    overlay.add_delta(
+                                        ct, rows, a.ask, writer=self.id
+                                    )
                             off += n
                 finally:
-                    self.server.placement_overlay.commit_started()
+                    overlay.commit_started()
                     overlay.pass_finished()
 
         # pipeline: the previous commit must finish before this pass's
@@ -508,7 +630,7 @@ class Worker:
             # the marker is taken in the device-pass block; a batch with
             # no kernel work (all singles) still needs it for the commit
             # thread's finally to balance
-            self.server.placement_overlay.commit_started()
+            overlay.commit_started()
         args = (prepared, all_asks, results, lane_ok, singles)
         self._commit_thread = threading.Thread(
             target=self._commit_batch, args=args,
@@ -535,7 +657,9 @@ class Worker:
             metrics.incr("nomad.chaos.thread_kills")
             count_swallowed("chaos", e)
         finally:
-            self.server.placement_overlay.commit_finished()
+            # must release the SAME overlay whose commit_started marker
+            # the device pass took (the worker's own in lane mode)
+            self._my_overlay().commit_finished()
 
     def _nack_member(self, ev, token, e, what: str) -> None:
         if isinstance(e, EvalDeadlineExceeded):
@@ -609,6 +733,7 @@ class Worker:
         buf = _EvalBuffer(server)
         members: list[tuple] = []  # (ev, token, sched, member plan)
         done: list[tuple] = []  # acked after the status flush below
+        claims: list = []  # confirmed cross-lane claims riding this commit
         try:
             # 1. build: turn each member's lane slice into a plan. A lane
             # conflict with no usable overflow candidate drops the member
@@ -643,6 +768,41 @@ class Worker:
                 else:
                     members.append((ev, token, sched, member))
 
+            # 1b. cross-lane handoff (lane mode): a member placing on a
+            # peer's nodes must hold a confirmed claim on them before
+            # riding the merged commit — reserve (refused if any node is
+            # already claimed/settled), then confirm (peer quiesced, no
+            # peer in-flight delta, fresh-snapshot capacity re-check).
+            # Either phase failing drops the member to the solo fallback
+            # in its own lanes; the reservation is released either way.
+            if self._lane_mode() and members:
+                kept: list[tuple] = []
+                for ev, token, sched, member in members:
+                    foreign = {
+                        node_id: list(allocs)
+                        for node_id, allocs in member.node_allocation.items()
+                        if server.lanes.owner_of_node(node_id) != self.id
+                    }
+                    if not foreign:
+                        kept.append((ev, token, sched, member))
+                        continue
+                    claim = server.lane_claims.reserve(
+                        self.id, ev.id, foreign
+                    )
+                    if claim is not None:
+                        # register with the finally BEFORE confirm: a
+                        # thread kill inside confirm must not leak the
+                        # reservation (release is idempotent, so the
+                        # immediate release below stays safe)
+                        claims.append(claim)
+                        if server.lane_claims.confirm(claim):
+                            kept.append((ev, token, sched, member))
+                            continue
+                        server.lane_claims.release(claim, committed=False)
+                    metrics.incr("nomad.worker.lane_handoff_fallbacks")
+                    singles.append((ev, token))
+                members = kept
+
             # 2. followup evals must exist BEFORE the plans that reference
             # them commit; one raft apply covers the whole batch's creates
             buf.flush()
@@ -657,8 +817,17 @@ class Worker:
                     with tracer.activate(ev.id):
                         ctxs.append(tracer.current_ctx())
                 t0 = time.perf_counter()
+                # past this point the applier may land the claimed
+                # placements even if this thread dies — the finally
+                # below must settle (not just drop) the claimed nodes
+                for claim in claims:
+                    claim.submitted = True
                 futures = server.plan_queue.enqueue_merged(
-                    MergedPlan(plans=[m[3] for m in members]),
+                    MergedPlan(
+                        plans=[m[3] for m in members],
+                        owner_worker=self.id if self._lane_mode() else -1,
+                        claims=list(claims),
+                    ),
                     trace_ctxs=ctxs,
                 )
                 # a kill here crashes the thread AFTER the merged plan
@@ -707,6 +876,16 @@ class Worker:
                 for i, (ev, token, sched, _member) in enumerate(members):
                     if mresults[i] is None:
                         continue  # nacked above
+                    if mresults[i].token_stale:
+                        # the applier dropped this member: the broker
+                        # redelivered the eval mid-pass and another
+                        # worker owns it now — no ack/nack (our token is
+                        # dead) and no singles retry (that would race
+                        # the new owner into a double commit)
+                        metrics.incr("nomad.worker.stale_token_drops")
+                        self._bump("processed")
+                        tracer.finish(ev.id, status="stale_token")
+                        continue
                     try:
                         with tracer.activate(ev.id):
                             completed = sched.complete_merged_attempt(
@@ -754,6 +933,16 @@ class Worker:
                     count_swallowed("worker", e2)
                 # finish() no-ops for evals already acked/finished above
                 tracer.finish(ev.id, status="nacked", error=repr(e))
+        finally:
+            # no leaked claims, EVER: release is idempotent and this
+            # finally runs even on ChaosThreadKill (a BaseException). A
+            # claim that made it to enqueue_merged settles its nodes (the
+            # applier may land it regardless of this thread's fate); one
+            # that did not is simply dropped.
+            for claim in claims:
+                server.lane_claims.release(
+                    claim, committed=claim.submitted
+                )
 
     def process_eval(self, ev: Evaluation, planner=None) -> None:
         # solo evals score against the shared overlay too (an overlay-
@@ -763,8 +952,12 @@ class Worker:
         # frozen base until placements fail on a near-empty cluster.
         # Safe from the commit thread's singles fallback: the commit
         # marker is still held there, so maybe_reset() is a no-op.
-        if self.server.placement_overlay.maybe_reset():
+        overlay = self._my_overlay()
+        if overlay.maybe_reset():
             metrics.incr("nomad.worker.pipeline_epoch_resets")
+        lane_mode = self._lane_mode()
+        if lane_mode:
+            self._rebase_lanes(overlay)
         # raft catch-up barrier (worker.go:536-549)
         with tracer.span(
             "wait_for_index", timer="nomad.worker.wait_for_index"
@@ -774,12 +967,24 @@ class Worker:
             snapshot = self.server.store.snapshot()
         # all workers share the server's resident device-state cache —
         # tensors refresh incrementally by state index, not per eval
+        kw = {}
+        if lane_mode and ev.type in ("service", "batch") and (
+            self.id < getattr(self.server.config, "num_batch_workers", 1)
+        ):
+            # a batch worker's SOLO fallback stays in its own lanes:
+            # the solo path has no cross-lane handoff, so foreign nodes
+            # are off the table (a shortfall blocks the eval, it never
+            # writes a peer's node). system/sysbatch/_core evals stay
+            # unrestricted — they are single-plan optimistic commits
+            # outside the merged-plan lane contract.
+            kw["node_filter"] = self._lane_node_filter
         sched = new_scheduler(
             ev.type,
             snapshot,
             planner if planner is not None else _TokenPlanner(self, ""),
             cache=self.server.device_cache,
-            overlay=self.server.placement_overlay,
+            overlay=overlay,
+            **kw,
         )
         with tracer.span(
             "invoke_scheduler", timer="nomad.worker.invoke_scheduler"

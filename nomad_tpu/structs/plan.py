@@ -123,6 +123,15 @@ class MergedPlan:
     """
 
     plans: list[Plan] = field(default_factory=list)
+    # lane mode: the batching worker that built this commit. With lanes
+    # active the applier ASSERTS every touched node is either owned by
+    # this worker or covered by one of the attached (confirmed)
+    # cross-lane claims — a violation is a structural bug, counted as
+    # nomad.plan.lane_conflicts and pinned at zero by invariant law 9.
+    owner_worker: int = -1
+    # confirmed LaneClaim objects riding this commit. Host-side only:
+    # never serialized into the raft entry (commit_merged ships results).
+    claims: list = field(default_factory=list)
 
     @property
     def priority(self) -> int:
@@ -150,6 +159,11 @@ class PlanResult:
     deployment_updates: list = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
+    # set by the applier when the plan's broker token was no longer the
+    # eval's outstanding token at apply time (unack-deadline redelivery
+    # handed the eval to another worker) — nothing was committed and the
+    # submitter must NOT retry: the redelivered copy owns the eval now
+    token_stale: bool = False
 
     def is_no_op(self) -> bool:
         return (

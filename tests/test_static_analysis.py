@@ -23,6 +23,7 @@ from nomad_tpu.analysis import lint, race, retrace
 from nomad_tpu.analysis.rules import REGISTRY
 from nomad_tpu.analysis.rules.determinism import WallClockInScoringPath
 from nomad_tpu.analysis.rules.hostsync import HostSyncInJitKernel
+from nomad_tpu.analysis.rules.laneowner import LaneOwnerDiscipline
 from nomad_tpu.analysis.rules.lockfields import LockDiscipline
 from nomad_tpu.analysis.rules.mergedsubmit import MergedSubmitDiscipline
 from nomad_tpu.analysis.rules.planfreeze import PlanMutationAfterSubmit
@@ -483,6 +484,94 @@ class TestNTA008:
                        BareWallClockInBrokerServer) == [], rel
 
 
+# -- NTA010: batch-path writes go through the lane-owner API ---------------
+
+
+class TestNTA010:
+    def test_direct_placement_overlay_in_batch_path_triggers(self):
+        src = (
+            "class Worker:\n"
+            "    def _run_batch(self, batch):\n"
+            "        ov = self.server.placement_overlay\n"
+            "        ov.begin_pass()\n"
+        )
+        fs = run(src, "nomad_tpu/server/worker.py", LaneOwnerDiscipline)
+        assert rule_ids(fs) == ["NTA010"]
+        assert fs[0].symbol == "Worker._run_batch"
+
+    def test_add_delta_without_writer_triggers(self):
+        src = (
+            "class Worker:\n"
+            "    def _run_batch(self, batch, overlay, ct, rows, ask):\n"
+            "        overlay.add_delta(ct, rows, ask)\n"
+        )
+        fs = run(src, "nomad_tpu/server/worker.py", LaneOwnerDiscipline)
+        assert rule_ids(fs) == ["NTA010"]
+
+    def test_tagged_add_delta_is_the_sanctioned_path(self):
+        src = (
+            "class Worker:\n"
+            "    def _run_batch(self, batch, overlay, ct, rows, ask):\n"
+            "        overlay.add_delta(ct, rows, ask, writer=self.id)\n"
+        )
+        assert (
+            run(src, "nomad_tpu/server/worker.py", LaneOwnerDiscipline)
+            == []
+        )
+
+    def test_direct_store_mutation_in_commit_thread_triggers(self):
+        src = (
+            "class Worker:\n"
+            "    def _commit_batch_inner(self, members):\n"
+            "        self.server.store.upsert_plan_results(1, members)\n"
+        )
+        fs = run(src, "nomad_tpu/server/worker.py", LaneOwnerDiscipline)
+        assert rule_ids(fs) == ["NTA010"]
+
+    def test_store_reads_are_clean(self):
+        src = (
+            "class Worker:\n"
+            "    def _run_batch(self, batch):\n"
+            "        snap = self.server.store.snapshot()\n"
+            "        self.server.store.wait_for_index(3, timeout=5.0)\n"
+        )
+        assert (
+            run(src, "nomad_tpu/server/worker.py", LaneOwnerDiscipline)
+            == []
+        )
+
+    def test_accessor_and_solo_path_are_exempt(self):
+        src = (
+            "class Worker:\n"
+            "    def _my_overlay(self):\n"
+            "        return self.server.placement_overlay\n"
+            "    def _run_one(self, ev, token, overlay, ct, rows, ask):\n"
+            "        self.server.placement_overlay.maybe_reset()\n"
+            "        overlay.add_delta(ct, rows, ask)\n"
+        )
+        assert (
+            run(src, "nomad_tpu/server/worker.py", LaneOwnerDiscipline)
+            == []
+        )
+
+    def test_other_modules_out_of_scope(self):
+        rule = LaneOwnerDiscipline()
+        assert rule.applies_to("nomad_tpu/server/worker.py")
+        assert not rule.applies_to("nomad_tpu/server/overlay.py")
+        assert not rule.applies_to("nomad_tpu/scheduler/generic.py")
+
+    def test_worker_at_head_is_clean(self):
+        """The real batch pipeline must already obey the lane contract —
+        zero offenders to ratchet."""
+        path = os.path.join(REPO_ROOT, "nomad_tpu", "server", "worker.py")
+        with open(path) as f:
+            src = f.read()
+        assert (
+            run(src, "nomad_tpu/server/worker.py", LaneOwnerDiscipline)
+            == []
+        )
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -552,7 +641,7 @@ class TestBaselineRatchet:
     def test_registry_covers_all_rules(self):
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
-            "NTA007", "NTA008", "NTA009",
+            "NTA007", "NTA008", "NTA009", "NTA010",
         ]
 
 
