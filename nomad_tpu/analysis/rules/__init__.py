@@ -12,6 +12,7 @@ from .mergedsubmit import MergedSubmitDiscipline  # noqa: E402
 from .wallclock import BareWallClockInBrokerServer  # noqa: E402
 from .blocking import BlockingWithoutTimeout  # noqa: E402
 from .laneowner import LaneOwnerDiscipline  # noqa: E402
+from .accumulation import UnboundedAccumulation  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -24,6 +25,7 @@ REGISTRY = [
     BareWallClockInBrokerServer,  # NTA008
     BlockingWithoutTimeout,  # NTA009
     LaneOwnerDiscipline,  # NTA010
+    UnboundedAccumulation,  # NTA011
 ]
 
 __all__ = ["REGISTRY"]

@@ -224,6 +224,11 @@ class HTTPAgent:
                 re.compile(r"^/v1/agent/resilience$"),
                 self.handle_agent_resilience,
             ),
+            (
+                # SLO surface: windowed latency percentiles + verdict
+                re.compile(r"^/v1/agent/slo$"),
+                self.handle_agent_slo,
+            ),
             (re.compile(r"^/v1/status/leader$"), self.handle_leader),
             (re.compile(r"^/v1/metrics$"), self.handle_metrics),
             (re.compile(r"^/v1/acl/bootstrap$"), self.handle_acl_bootstrap),
@@ -1453,6 +1458,26 @@ class HTTPAgent:
                 or k == "nomad.broker.nack_redelivery_delayed"
             },
         }
+
+    def handle_agent_slo(self, method, body, query):
+        """/v1/agent/slo — the live SLO report: eval/placement latency
+        percentiles from the always-on ``nomad.slo.*`` series the
+        flight recorder feeds, current queue depth, resilience/lane
+        counters, flight-recorder ring coverage, and the verdict
+        against targets (defaults; override any ``SloTargets`` field
+        via a query parameter, e.g. ``?eval_p99_ms=100``)."""
+        self._enforce(query, "agent_read")
+        from ..obs.slo import SloTargets, live_report
+
+        targets = SloTargets()
+        for f in SloTargets.FIELDS:
+            if f in query:
+                raw = query[f]
+                setattr(
+                    targets, f,
+                    None if raw in ("", "none", "null") else float(raw),
+                )
+        return live_report(self.server, targets)
 
     # -- ACL endpoints (nomad/acl_endpoint.go) -----------------------------
     def handle_acl_bootstrap(self, method, body, query):

@@ -720,6 +720,66 @@ def cmd_resilience_status(args) -> int:
     return 0
 
 
+def cmd_slo_report(args) -> int:
+    """`nomad-tpu slo report` — the live SLO report from
+    /v1/agent/slo: eval/placement latency percentiles (always-on, fed
+    by the flight recorder), queue depth, resilience/lane counters,
+    ring coverage, and the verdict against declared targets."""
+    c = _client(args)
+    params = {}
+    if args.eval_p99_ms is not None:
+        params["eval_p99_ms"] = args.eval_p99_ms
+    if args.placement_p99_ms is not None:
+        params["placement_p99_ms"] = args.placement_p99_ms
+    qs = "&".join(f"{k}={v}" for k, v in params.items())
+    try:
+        out = c._request("GET", "/v1/agent/slo" + (f"?{qs}" if qs else ""))
+    except APIException as e:
+        return _fail(str(e))
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    slo = out.get("slo", {})
+    targets = out.get("targets", {})
+    for key, label in (
+        ("eval_latency_ms", "eval latency"),
+        ("placement_latency_ms", "placement"),
+        ("plan_apply_ms", "plan apply"),
+    ):
+        s = slo.get(key, {})
+        print(
+            f"{label:<14} p50={s.get('p50_ms', 0.0):>9.2f}ms "
+            f"p95={s.get('p95_ms', 0.0):>9.2f}ms "
+            f"p99={s.get('p99_ms', 0.0):>9.2f}ms "
+            f"max={s.get('max_ms', 0.0):>9.2f}ms "
+            f"(n={s.get('count', 0)})"
+        )
+    q = slo.get("queue_depth", {})
+    print(f"queue depth    now={q.get('max', 0.0):.0f}")
+    cov = slo.get("ring_coverage", {})
+    print(
+        f"trace ring     recorded={cov.get('traces_recorded', 0)} "
+        f"evicted={cov.get('traces_evicted', 0)} "
+        f"coverage={cov.get('coverage', 1.0):.2%}"
+    )
+    ctr = slo.get("counters", {})
+    nonzero = {k: v for k, v in sorted(ctr.items()) if v}
+    if nonzero:
+        print("counters:")
+        for k, v in nonzero.items():
+            print(f"  {k} = {int(v)}")
+    v = slo.get("verdict", {})
+    if v.get("pass"):
+        print("SLO PASS")
+        return 0
+    print("SLO FAIL:")
+    for f in v.get("failures", ()):
+        print(f"  {f}")
+    checked = {k: t for k, t in targets.items() if t is not None}
+    print("targets: " + " ".join(f"{k}={t:g}" for k, t in checked.items()))
+    return 1
+
+
 def cmd_scaling_policies(args) -> int:
     """`nomad scaling policy list` (command/scaling_policy_list.go)."""
     c = _client(args)
@@ -1219,6 +1279,22 @@ def build_parser() -> argparse.ArgumentParser:
     rstat = res.add_parser("status")
     rstat.add_argument("-json", action="store_true")
     rstat.set_defaults(fn=cmd_resilience_status)
+
+    slo = sub.add_parser(
+        "slo", help="steady-state SLO report"
+    ).add_subparsers(dest="slo_cmd", required=True)
+    srep = slo.add_parser("report")
+    srep.add_argument("-json", action="store_true")
+    srep.add_argument(
+        "--eval-p99-ms", type=float, default=None, dest="eval_p99_ms",
+        help="override the eval-latency p99 target for the verdict",
+    )
+    srep.add_argument(
+        "--placement-p99-ms", type=float, default=None,
+        dest="placement_p99_ms",
+        help="override the placement-latency p99 target for the verdict",
+    )
+    srep.set_defaults(fn=cmd_slo_report)
 
     ver = sub.add_parser("version", help="show version")
     ver.set_defaults(fn=cmd_version)

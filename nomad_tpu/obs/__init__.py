@@ -1,6 +1,7 @@
-"""nomad_tpu.obs — zero-dependency tracing + profiling.
+"""nomad_tpu.obs — zero-dependency tracing, profiling and SLOs.
 
-Three parts (see trace.py / recorder.py and utils/backend.py):
+Four parts (see trace.py / recorder.py / slo.py / loadgen.py and
+utils/backend.py):
 
 - **Spans**: ``global_tracer`` keys one trace tree per eval id and
   carries it across the worker → plan-queue → applier thread handoff.
@@ -10,23 +11,49 @@ Three parts (see trace.py / recorder.py and utils/backend.py):
 - **Flight recorder**: ``flight_recorder`` rings the last N completed
   traces + error events, surfaced at ``/v1/agent/trace`` and rendered by
   the ``nomad-tpu trace`` CLI.
+- **SLO plane**: ``SloCollector`` windows eval/placement latency from
+  the recorder's trace feed into bounded histograms; ``run_soak``
+  replays a seeded Poisson traffic schedule against a live cluster and
+  reports against declared ``SloTargets`` (``/v1/agent/slo``,
+  ``nomad-tpu slo report``, ``bench.py soak``).
 """
 
+from .loadgen import SoakRun, build_schedule, run_soak, saturation_search
 from .recorder import (
     FlightRecorder,
     flight_recorder,
     phase_breakdown,
     render_trace,
+    trace_latencies,
+)
+from .slo import (
+    SLO_SCHEMA,
+    SloCollector,
+    SloTargets,
+    build_report,
+    live_report,
+    slo_schema_of,
 )
 from .trace import Span, SpanContext, Tracer, global_tracer
 
 __all__ = [
     "FlightRecorder",
+    "SLO_SCHEMA",
+    "SloCollector",
+    "SloTargets",
+    "SoakRun",
     "Span",
     "SpanContext",
     "Tracer",
+    "build_report",
+    "build_schedule",
     "flight_recorder",
     "global_tracer",
+    "live_report",
     "phase_breakdown",
     "render_trace",
+    "run_soak",
+    "saturation_search",
+    "slo_schema_of",
+    "trace_latencies",
 ]

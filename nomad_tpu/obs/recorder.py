@@ -17,7 +17,10 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Optional
+from typing import Callable, Optional
+
+from ..utils.hist import pct_nearest_rank
+from ..utils.metrics import global_metrics
 
 DEFAULT_CAPACITY = 256
 DEFAULT_ERROR_CAPACITY = 100
@@ -39,16 +42,53 @@ class FlightRecorder:
         # conservation checks (chaos invariant: every swallowed-error
         # counter bump has a ring event) survive ring wraparound
         self.errors_total = 0
+        # lifetime trace counts: how much of a run the 256-trace ring
+        # actually covered, so SLO reports can state coverage instead
+        # of silently truncating to the newest 256
+        self.traces_total = 0
+        self.traces_evicted = 0
+        # listeners see every completed trace even when the ring
+        # wraps — the SLO collector windows latencies through this
+        self._listeners: list[Callable[[dict], None]] = []
 
     # -- writes ------------------------------------------------------------
+    def add_listener(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
     def record(self, trace: dict) -> None:
         eval_id = trace.get("eval_id", "")
+        evicted = 0
         with self._lock:
             if eval_id in self._traces:
                 del self._traces[eval_id]
             self._traces[eval_id] = trace
+            self.traces_total += 1
             while len(self._traces) > self.capacity:
                 self._traces.popitem(last=False)
+                evicted += 1
+            self.traces_evicted += evicted
+            listeners = list(self._listeners)
+        # metrics bump + listener fan-out happen OUTSIDE the recorder
+        # lock: listeners take their own locks, and the registry lock
+        # must never nest under this one (same rule as Tracer.finish)
+        if evicted:
+            global_metrics.incr("nomad.obs.traces_evicted", evicted)
+        eval_s, placement_s = trace_latencies(trace)
+        global_metrics.measure("nomad.slo.eval_latency", eval_s)
+        if placement_s > 0.0:
+            global_metrics.measure("nomad.slo.placement_latency", placement_s)
+        for fn in listeners:
+            try:
+                fn(trace)
+            except Exception:
+                global_metrics.incr("nomad.obs.listener_errors")
 
     def record_error(
         self, component: str, error: str, eval_id: str = ""
@@ -102,6 +142,33 @@ class FlightRecorder:
     def __len__(self) -> int:
         with self._lock:
             return len(self._traces)
+
+
+def trace_latencies(trace: dict) -> tuple[float, float]:
+    """(eval_latency_s, placement_latency_s) for one completed trace —
+    THE latency definitions every SLO surface shares.
+
+    Eval latency is end-to-end from the user's side of the broker:
+    ready-queue wait (the ``queue_wait_ms`` tag the worker stamps on
+    the dequeue span) plus the trace's own dequeue→ack duration.
+    Placement latency is the schedule-and-commit core: the summed
+    durations of the ``invoke_scheduler`` and ``submit_plan`` spans.
+    """
+    queue_wait_ms = 0.0
+    placement_ms = 0.0
+    for s in trace.get("spans", ()):
+        name = s.get("name", "")
+        if name == "dequeue":
+            try:
+                queue_wait_ms += float(
+                    s.get("tags", {}).get("queue_wait_ms", 0.0)
+                )
+            except (TypeError, ValueError):
+                pass
+        elif name in ("invoke_scheduler", "submit_plan"):
+            placement_ms += float(s.get("duration_ms") or 0.0)
+    eval_ms = queue_wait_ms + float(trace.get("duration_ms") or 0.0)
+    return eval_ms / 1000.0, placement_ms / 1000.0
 
 
 flight_recorder = FlightRecorder()
@@ -171,7 +238,7 @@ def phase_breakdown(traces: list[dict]) -> dict:
     for name in sorted(by_name):
         buf = sorted(by_name[name])
         n = len(buf)
-        p95 = buf[min(n - 1, int(round(0.95 * (n - 1))))]
+        p95 = pct_nearest_rank(buf, 0.95)
         out[name] = {
             "count": n,
             "mean_ms": round(sum(buf) / n, 3),

@@ -4,6 +4,12 @@ Reference: armon/go-metrics gauges/timers used throughout the reference
 (`nomad.worker.*` worker.go:461,495,553; `nomad.plan.*` plan_apply.go:185)
 surfaced at /v1/metrics (http.go:333). Counters, gauges and timing
 samples with mean/max, zero dependencies.
+
+Timing series are held as bounded :class:`~nomad_tpu.utils.hist.LogHistogram`
+buckets — O(buckets) memory per key no matter how many samples are
+recorded, so a minutes-long soak can't grow the registry. Percentiles
+read from bucket counts land within one ~7%-wide bucket of the exact
+sorted-list answer; count/mean/max stay exact.
 """
 
 from __future__ import annotations
@@ -13,13 +19,15 @@ import threading
 import time
 from contextlib import contextmanager
 
+from .hist import LogHistogram, pct_nearest_rank
+
 
 class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
-        self._samples: dict[str, list[float]] = {}
+        self._samples: dict[str, LogHistogram] = {}
 
     def incr(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -31,10 +39,10 @@ class Metrics:
 
     def measure(self, name: str, seconds: float) -> None:
         with self._lock:
-            buf = self._samples.setdefault(name, [])
-            buf.append(seconds)
-            if len(buf) > 8192:
-                del buf[: len(buf) - 8192]
+            hist = self._samples.get(name)
+            if hist is None:
+                hist = self._samples[name] = LogHistogram()
+            hist.record(seconds)
 
     @contextmanager
     def timer(self, name: str):
@@ -46,31 +54,24 @@ class Metrics:
 
     @staticmethod
     def _pct(sorted_buf: list[float], q: float) -> float:
-        if not sorted_buf:
-            return 0.0
-        i = min(len(sorted_buf) - 1, int(round(q * (len(sorted_buf) - 1))))
-        return sorted_buf[i]
+        return pct_nearest_rank(sorted_buf, q)
+
+    def histograms(self) -> dict[str, LogHistogram]:
+        """Point-in-time copies of every timing series, for callers
+        (the SLO collector) that want to window-diff bucket counts."""
+        with self._lock:
+            return {name: h.copy() for name, h in self._samples.items()}
 
     def snapshot(self) -> dict:
-        # copy under the lock, sort outside it: percentile recomputation
-        # over up to 8192 samples per key is O(n log n) per series, and
-        # holding the registry lock through it would stall every
-        # measure()/incr() on the worker hot path while /v1/metrics renders
+        # copy under the lock, summarize outside it: a percentile read
+        # walks every bucket per series, and holding the registry lock
+        # through it would stall every measure()/incr() on the worker
+        # hot path while /v1/metrics renders
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
-            buffers = {name: list(buf) for name, buf in self._samples.items()}
-        samples = {}
-        for name, buf in buffers.items():
-            s = sorted(buf)
-            samples[name] = {
-                "count": len(buf),
-                "mean_ms": (sum(buf) / len(buf)) * 1000 if buf else 0.0,
-                "p50_ms": self._pct(s, 0.50) * 1000,
-                "p95_ms": self._pct(s, 0.95) * 1000,
-                "p99_ms": self._pct(s, 0.99) * 1000,
-                "max_ms": s[-1] * 1000 if s else 0.0,
-            }
+            hists = {name: h.copy() for name, h in self._samples.items()}
+        samples = {name: h.snapshot() for name, h in hists.items()}
         return {
             "counters": counters,
             "gauges": gauges,

@@ -1,0 +1,656 @@
+"""Steady-state SLO harness: bounded histograms / time-series rings,
+seeded loadgen determinism, SLO verdict logic, flight-recorder ring
+coverage, the NTA011 accumulation lint rule, the /v1/agent/slo surface,
+a ~5s tier-1 smoke soak pinning the report schema, and the slow-marked
+60s soak at 10k nodes / 4 batch workers.
+"""
+
+import json
+import random
+import sys
+import threading
+
+import pytest
+
+from nomad_tpu.obs.loadgen import SoakEvent, build_schedule, run_soak
+from nomad_tpu.obs.recorder import FlightRecorder, trace_latencies
+from nomad_tpu.obs.slo import (
+    REPORT_COUNTERS,
+    SLO_SCHEMA,
+    SloCollector,
+    SloTargets,
+    build_report,
+    slo_schema_of,
+)
+from nomad_tpu.utils.hist import (
+    LogHistogram,
+    TimeSeriesRing,
+    pct_nearest_rank,
+)
+from nomad_tpu.utils.metrics import Metrics
+
+
+# -- bounded histogram ------------------------------------------------------
+
+
+class TestLogHistogram:
+    def test_percentiles_within_bucket_error_of_exact_sort(self):
+        rng = random.Random(42)
+        for dist in (
+            lambda: rng.uniform(1e-4, 2.0),
+            lambda: rng.lognormvariate(-5.0, 2.0),
+            lambda: rng.expovariate(100.0) + 1e-6,
+        ):
+            h = LogHistogram()
+            vals = [dist() for _ in range(20_000)]
+            for v in vals:
+                h.record(v)
+            s = sorted(vals)
+            # one geometric bucket is a factor of `growth` wide, so the
+            # histogram's nearest-rank answer is within that factor of
+            # the exact sorted-list answer
+            for q in (0.5, 0.9, 0.95, 0.99, 0.999):
+                exact = pct_nearest_rank(s, q)
+                approx = h.percentile(q)
+                assert exact / h.growth <= approx <= exact * h.growth, (
+                    q, exact, approx,
+                )
+
+    def test_count_mean_min_max_exact(self):
+        h = LogHistogram()
+        vals = [0.001, 0.5, 2.0, 0.25]
+        for v in vals:
+            h.record(v)
+        assert h.count == 4
+        assert h.min == min(vals) and h.max == max(vals)
+        snap = h.snapshot()
+        assert snap["count"] == 4
+        assert snap["max_ms"] == pytest.approx(2000.0)
+        assert snap["mean_ms"] == pytest.approx(
+            sum(vals) / len(vals) * 1000
+        )
+
+    def test_memory_is_bounded(self):
+        h = LogHistogram()
+        buckets = len(h.counts)
+        rng = random.Random(7)
+        for _ in range(200_000):
+            h.record(rng.lognormvariate(-4.0, 3.0))
+        # same bucket array, no auxiliary growth: the histogram's whole
+        # state is __slots__ scalars + this fixed list
+        assert len(h.counts) == buckets
+        assert not hasattr(h, "__dict__")
+
+    def test_out_of_range_values_clamp_to_edge_buckets(self):
+        h = LogHistogram(lo=1e-3, hi=10.0)
+        h.record(1e-9)
+        h.record(1e9)
+        assert h.counts[0] == 1 and h.counts[-1] == 1
+        assert h.count == 2
+        # percentile never invents values outside the observed range
+        assert h.percentile(0.0) >= h.min
+        assert h.percentile(1.0) <= h.max
+
+    def test_empty_snapshot_shape_matches_legacy_keys(self):
+        assert LogHistogram().snapshot() == {
+            "count": 0, "mean_ms": 0.0, "p50_ms": 0.0,
+            "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0,
+        }
+
+    def test_diff_windows_bucket_counts(self):
+        h = LogHistogram()
+        for v in (0.01, 0.02, 0.03):
+            h.record(v)
+        base = h.copy()
+        for v in (0.5, 0.6):
+            h.record(v)
+        w = h.diff(base)
+        assert w.count == 2
+        # nearest-rank p50 of {0.5, 0.6} is one of the two observed
+        # values, reported to within one bucket's width
+        p50 = w.percentile(0.5)
+        assert 0.5 / h.growth <= p50 <= 0.6 * h.growth
+
+
+class TestMetricsRegistryBounded:
+    def test_samples_are_histograms_not_lists(self):
+        m = Metrics()
+        for i in range(10_000):
+            m.measure("x", 0.001 * (i % 100 + 1))
+        hist = m.histograms()["x"]
+        assert isinstance(hist, LogHistogram)
+        buckets = len(hist.counts)
+        for i in range(50_000):
+            m.measure("x", 0.001 * (i % 100 + 1))
+        assert len(m.histograms()["x"].counts) == buckets
+
+    def test_snapshot_shape_unchanged(self):
+        m = Metrics()
+        m.incr("c")
+        m.set_gauge("g", 2.0)
+        with m.timer("t"):
+            pass
+        snap = m.snapshot()
+        assert set(snap) == {"counters", "gauges", "samples"}
+        assert set(snap["samples"]["t"]) == {
+            "count", "mean_ms", "p50_ms", "p95_ms", "p99_ms", "max_ms",
+        }
+        assert snap["samples"]["t"]["count"] == 1
+
+    def test_snapshot_percentiles_track_exact_for_narrow_series(self):
+        m = Metrics()
+        vals = [0.010, 0.012, 0.011, 0.013, 0.100]
+        for v in vals:
+            m.measure("t", v)
+        s = m.snapshot()["samples"]["t"]
+        exact_p95 = pct_nearest_rank(sorted(vals), 0.95) * 1000
+        assert s["p95_ms"] == pytest.approx(exact_p95, rel=0.08)
+        assert s["max_ms"] == pytest.approx(100.0)
+
+
+class TestTimeSeriesRing:
+    def test_per_second_slots_and_stats(self):
+        r = TimeSeriesRing(seconds=10)
+        r.observe(100.2, 5.0)
+        r.observe(100.7, 15.0)
+        r.observe(101.1, 10.0)
+        r.incr(100.5, 3)
+        st = r.stats(now=101.5)
+        assert st["seconds"] == 2
+        assert st["max"] == 15.0
+        assert st["events"] == 3
+        rows = r.series(now=101.5)
+        assert [row[0] for row in rows] == [100, 101]
+        assert rows[0][1] == pytest.approx(10.0)  # mean of 5, 15
+
+    def test_old_slots_are_overwritten_not_accumulated(self):
+        r = TimeSeriesRing(seconds=5)
+        for sec in range(100):
+            r.observe(float(sec), 1.0)
+        assert len(r._epoch) == 5
+        st = r.stats(now=99.5)
+        assert st["seconds"] <= 5
+
+
+# -- latency definitions ----------------------------------------------------
+
+
+def _trace(duration_ms=10.0, queue_wait_ms=5.0, sched_ms=3.0, plan_ms=2.0):
+    return {
+        "eval_id": "e1",
+        "status": "acked",
+        "duration_ms": duration_ms,
+        "spans": [
+            {"name": "dequeue", "parent_id": 1,
+             "tags": {"queue_wait_ms": queue_wait_ms}},
+            {"name": "invoke_scheduler", "parent_id": 1,
+             "duration_ms": sched_ms, "tags": {}},
+            {"name": "submit_plan", "parent_id": 1,
+             "duration_ms": plan_ms, "tags": {}},
+        ],
+    }
+
+
+class TestTraceLatencies:
+    def test_eval_latency_is_queue_wait_plus_duration(self):
+        ev, pl = trace_latencies(_trace())
+        assert ev == pytest.approx(0.015)
+        assert pl == pytest.approx(0.005)
+
+    def test_missing_spans_degrade_to_duration_only(self):
+        ev, pl = trace_latencies(
+            {"duration_ms": 8.0, "spans": [], "eval_id": "x"}
+        )
+        assert ev == pytest.approx(0.008)
+        assert pl == 0.0
+
+
+# -- flight recorder ring coverage -----------------------------------------
+
+
+class TestRingCoverage:
+    def test_eviction_counter_counts_ring_overflow(self):
+        rec = FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record({"eval_id": f"e{i}", "spans": [], "duration_ms": 1.0})
+        assert rec.traces_total == 10
+        assert rec.traces_evicted == 6
+        assert len(rec) == 4
+
+    def test_re_recording_same_eval_does_not_evict(self):
+        rec = FlightRecorder(capacity=4)
+        for _ in range(10):
+            rec.record({"eval_id": "same", "spans": [], "duration_ms": 1.0})
+        assert rec.traces_evicted == 0
+
+    def test_listeners_see_every_trace_even_past_eviction(self):
+        rec = FlightRecorder(capacity=2)
+        seen = []
+        rec.add_listener(seen.append)
+        try:
+            for i in range(6):
+                rec.record(
+                    {"eval_id": f"e{i}", "spans": [], "duration_ms": 1.0}
+                )
+        finally:
+            rec.remove_listener(seen.append)
+        assert len(seen) == 6
+        rec.record({"eval_id": "after", "spans": [], "duration_ms": 1.0})
+        assert len(seen) == 6  # detached
+
+    def test_listener_exception_does_not_break_recording(self):
+        rec = FlightRecorder(capacity=4)
+
+        def boom(trace):
+            raise RuntimeError("listener bug")
+
+        rec.add_listener(boom)
+        try:
+            rec.record({"eval_id": "e", "spans": [], "duration_ms": 1.0})
+        finally:
+            rec.remove_listener(boom)
+        assert len(rec) == 1
+
+
+# -- collector + verdict ----------------------------------------------------
+
+
+class TestSloCollector:
+    def test_windows_latencies_from_trace_feed(self):
+        rec = FlightRecorder(capacity=2)
+        c = SloCollector(recorder=rec)
+        c.attach()
+        try:
+            for i in range(20):
+                rec.record(_trace(duration_ms=10.0 + i))
+        finally:
+            c.detach()
+        slo = c.measured()
+        assert slo["eval_latency_ms"]["count"] == 20
+        assert slo["placement_latency_ms"]["count"] == 20
+        assert slo["eval_latency_ms"]["p99_ms"] > 0
+
+    def test_report_schema_is_pinned(self):
+        slo = build_report(SloCollector(), SloTargets())
+        assert slo_schema_of(slo) == SLO_SCHEMA
+
+    def test_counters_are_windowed_deltas(self):
+        from nomad_tpu.utils.metrics import global_metrics
+
+        global_metrics.incr("nomad.resilience.trips_total", 5)
+        c = SloCollector()
+        global_metrics.incr("nomad.resilience.trips_total", 2)
+        slo = c.measured()
+        assert slo["counters"]["breaker_trips"] == 2
+
+    def test_thread_safe_under_concurrent_feed(self):
+        rec = FlightRecorder(capacity=8)
+        c = SloCollector(recorder=rec)
+        c.attach()
+
+        def feed():
+            for i in range(200):
+                rec.record(_trace(duration_ms=float(i % 17 + 1)))
+
+        threads = [threading.Thread(target=feed) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        c.detach()
+        assert c.measured()["eval_latency_ms"]["count"] == 800
+
+
+class TestVerdict:
+    def _slo(self, **over):
+        c = SloCollector()
+        slo = c.measured()
+        for path, v in over.items():
+            block, key = path.split("__")
+            slo[block][key] = v
+        return slo
+
+    def test_pass_when_everything_under_target(self):
+        v = SloTargets().verdict(self._slo())
+        assert v["pass"] and v["failures"] == []
+
+    def test_latency_breach_fails_with_reason(self):
+        slo = self._slo(
+            eval_latency_ms__count=10, eval_latency_ms__p99_ms=9000.0
+        )
+        v = SloTargets(eval_p99_ms=5000.0).verdict(slo)
+        assert not v["pass"]
+        assert any("eval_p99_ms" in f for f in v["failures"])
+
+    def test_counter_breach_fails(self):
+        slo = self._slo(counters__breaker_trips=3)
+        v = SloTargets(max_breaker_trips=0).verdict(slo)
+        assert not v["pass"]
+        assert any("breaker_trips" in f for f in v["failures"])
+
+    def test_none_target_disables_check(self):
+        slo = self._slo(
+            eval_latency_ms__count=10, eval_latency_ms__p99_ms=9e9
+        )
+        v = SloTargets(eval_p99_ms=None).verdict(slo)
+        assert v["pass"]
+
+    def test_empty_latency_window_is_not_a_latency_breach(self):
+        v = SloTargets(eval_p99_ms=0.001).verdict(self._slo())
+        assert v["pass"]
+
+    def test_targets_roundtrip(self):
+        t = SloTargets(eval_p99_ms=123.0, max_swallowed_errors=4.0)
+        t2 = SloTargets.from_dict(t.to_dict())
+        assert t2.to_dict() == t.to_dict()
+
+
+# -- loadgen determinism ----------------------------------------------------
+
+
+class TestLoadgenDeterminism:
+    def test_same_seed_same_schedule(self):
+        a = build_schedule(11, 20.0, 15.0, 100)
+        b = build_schedule(11, 20.0, 15.0, 100)
+        assert [e.row() for e in a] == [e.row() for e in b]
+        assert len(a) > 100
+
+    def test_different_seed_different_schedule(self):
+        a = [e.row() for e in build_schedule(11, 20.0, 15.0, 100)]
+        c = [e.row() for e in build_schedule(12, 20.0, 15.0, 100)]
+        assert a != c
+
+    def test_poisson_rate_is_respected(self):
+        sched = build_schedule(
+            5, 100.0, 20.0, 50, drain_rate=0.0, flap_rate=0.0,
+            update_frac=0.0, stop_frac=0.0,
+        )
+        arrivals = [e for e in sched if e.kind == "arrive"]
+        # 100s at 20/s → ~2000 arrivals; 3 sigma ≈ 134
+        assert 1800 <= len(arrivals) <= 2200
+
+    def test_drains_and_flaps_carry_paired_restores(self):
+        sched = build_schedule(
+            9, 60.0, 1.0, 20, drain_rate=0.5, flap_rate=0.5,
+        )
+        kinds = [e.kind for e in sched]
+        assert kinds.count("drain") == kinds.count("undrain")
+        assert kinds.count("down") == kinds.count("up")
+        assert kinds.count("drain") > 0 and kinds.count("down") > 0
+
+    def test_event_rows_are_stable_strings(self):
+        e = SoakEvent(1.25, "arrive", 3, count=2, priority=50)
+        assert e.row() == "   1.250s arrive #3 count=2 prio=50"
+
+
+# -- NTA011 lint rule -------------------------------------------------------
+
+
+class TestNTA011:
+    def _check(self, src, relpath="nomad_tpu/obs/fixture.py"):
+        from nomad_tpu.analysis.lint import check_source
+        from nomad_tpu.analysis.rules.accumulation import (
+            UnboundedAccumulation,
+        )
+
+        return check_source(src, relpath, [UnboundedAccumulation()])
+
+    def test_flags_append_only_self_attribute(self):
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.log = []\n"
+            "    def record(self, x):\n"
+            "        self.log.append(x)\n"
+        )
+        fs = self._check(src)
+        assert [f.rule for f in fs] == ["NTA011"]
+        assert "self.log" in fs[0].message
+
+    def test_eviction_path_clears_the_finding(self):
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.log = []\n"
+            "    def record(self, x):\n"
+            "        self.log.append(x)\n"
+            "        if len(self.log) > 10:\n"
+            "            del self.log[:5]\n"
+        )
+        assert self._check(src) == []
+
+    def test_rebuild_assignment_counts_as_eviction(self):
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.log = []\n"
+            "    def record(self, x):\n"
+            "        self.log.append(x)\n"
+            "    def gc(self):\n"
+            "        self.log = [v for v in self.log if v.live]\n"
+        )
+        assert self._check(src) == []
+
+    def test_deque_maxlen_is_bounded_by_construction(self):
+        src = (
+            "from collections import deque\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.log = deque(maxlen=100)\n"
+            "    def record(self, x):\n"
+            "        self.log.append(x)\n"
+        )
+        assert self._check(src) == []
+
+    def test_flags_module_level_container(self):
+        src = (
+            "_registry = []\n"
+            "def register(x):\n"
+            "    _registry.append(x)\n"
+        )
+        fs = self._check(src, "nomad_tpu/broker/fixture.py")
+        assert [f.rule for f in fs] == ["NTA011"]
+
+    def test_alias_eviction_is_credited(self):
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.by_key = {}\n"
+            "    def record(self, k, x):\n"
+            "        self.by_key.setdefault(k, set()).add(x)\n"
+            "    def reset(self, k):\n"
+            "        s = self.by_key.get(k)\n"
+            "        if s:\n"
+            "            s.clear()\n"
+        )
+        assert self._check(src) == []
+
+    def test_out_of_scope_paths_are_ignored(self):
+        src = (
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.log = []\n"
+            "    def record(self, x):\n"
+            "        self.log.append(x)\n"
+        )
+        assert self._check(src, "nomad_tpu/scheduler/fixture.py") == []
+
+    def test_repo_is_clean_under_nta011(self):
+        from pathlib import Path
+
+        from nomad_tpu.analysis.lint import (
+            default_baseline_path,
+            diff_against_baseline,
+            load_baseline,
+            run_lint,
+        )
+        from nomad_tpu.analysis.rules.accumulation import (
+            UnboundedAccumulation,
+        )
+
+        root = Path(__file__).resolve().parent.parent
+        findings = [
+            f
+            for f in run_lint(root, rules=[UnboundedAccumulation()])
+            if f.rule == "NTA011"
+        ]
+        baseline = load_baseline(default_baseline_path())
+        new, _fixed = diff_against_baseline(findings, baseline)
+        assert new == [], "\n".join(f.render() for f in new)
+
+
+# -- soak smoke (tier-1) ----------------------------------------------------
+
+
+class TestSoakSmoke:
+    @pytest.fixture(scope="class")
+    def smoke(self):
+        return run_soak(
+            seed=7, seconds=4.0, rate=10.0, nodes=50, batch_workers=1,
+            drain_rate=0.25, flap_rate=0.25,
+        )
+
+    def test_invariants_clean(self, smoke):
+        assert smoke.ok, smoke.render(verbose=True)
+
+    def test_slo_report_is_populated(self, smoke):
+        slo = smoke.slo
+        assert slo["eval_latency_ms"]["count"] > 0
+        assert slo["eval_latency_ms"]["p99_ms"] > 0
+        assert slo["placement_latency_ms"]["count"] > 0
+        assert slo["throughput"]["arrivals"] > 0
+        assert slo["throughput"]["completions"] > 0
+        assert "pass" in slo["verdict"]
+
+    def test_report_schema_pinned(self, smoke):
+        assert slo_schema_of(smoke.slo) == SLO_SCHEMA
+        # every report counter resolves to a real metrics key
+        assert set(smoke.slo["counters"]) == (
+            set(REPORT_COUNTERS) | {"swallowed_errors"}
+        )
+
+    def test_canonical_is_pure_function_of_args(self, smoke):
+        c = smoke.canonical()
+        assert c["schedule"] == [
+            e.row()
+            for e in build_schedule(
+                7, 4.0, 10.0, 50, drain_rate=0.25, flap_rate=0.25,
+            )
+        ]
+        # canonical must json-roundtrip byte-identically (sorted keys)
+        assert json.loads(smoke.canonical_json()) == c
+        # and contain no timing-dependent data
+        assert "slo" not in c and "duration_s" not in c
+
+    def test_node_churn_actually_happened(self, smoke):
+        assert smoke.workload["drains"] + smoke.workload["flaps"] > 0
+
+    def test_render_mentions_verdict(self, smoke):
+        out = smoke.render()
+        assert "SLO PASS" in out or "SLO FAIL" in out
+
+
+class TestHTTPSurface:
+    def test_agent_slo_endpoint(self):
+        from nomad_tpu import mock
+        from nomad_tpu.api.client import NomadClient
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_workers=1))
+        server.establish_leadership()
+        http = HTTPAgent(server, None, port=0)
+        http.start()
+        try:
+            c = NomadClient(http.address)
+            for _ in range(2):
+                server.register_node(mock.node())
+            server.register_job(mock.job())
+            assert server.wait_for_evals(timeout=15)
+            out = c._request("GET", "/v1/agent/slo")
+            assert set(out) == {"targets", "slo", "schema"}
+            assert slo_schema_of(out["slo"]) == tuple(out["schema"])
+            assert out["slo"]["eval_latency_ms"]["count"] > 0
+            assert "pass" in out["slo"]["verdict"]
+            # target override via query parameter flips the verdict
+            strict = c._request(
+                "GET", "/v1/agent/slo?eval_p99_ms=0.000001"
+            )
+            assert strict["slo"]["verdict"]["pass"] is False
+        finally:
+            http.stop()
+            server.shutdown()
+
+    def test_cli_slo_report(self, capsys):
+        from nomad_tpu import mock
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.cli.main import main as cli_main
+        from nomad_tpu.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_workers=1))
+        server.establish_leadership()
+        http = HTTPAgent(server, None, port=0)
+        http.start()
+        try:
+            server.register_node(mock.node())
+            server.register_job(mock.job())
+            assert server.wait_for_evals(timeout=15)
+            rc = cli_main(
+                ["-address", http.address, "slo", "report"]
+            )
+            out = capsys.readouterr().out
+            assert "eval latency" in out
+            assert rc in (0, 1)  # verdict decides the exit code
+            rc = cli_main(
+                ["-address", http.address, "slo", "report", "-json"]
+            )
+            parsed = json.loads(capsys.readouterr().out)
+            assert "slo" in parsed
+        finally:
+            http.stop()
+            server.shutdown()
+
+
+# -- the 60s soak (slow) ----------------------------------------------------
+
+
+@pytest.mark.slow
+class TestSoak60s:
+    def test_60s_soak_10k_nodes_4_workers(self):
+        run = run_soak(
+            seed=7,
+            seconds=60.0,
+            rate=25.0,
+            nodes=10_000,
+            batch_workers=4,
+            drain_rate=0.1,
+            flap_rate=0.1,
+            quiesce_timeout=120.0,
+            saturation=True,
+            saturation_kwargs={
+                "probe_seconds": 2.0, "nodes": 200, "iterations": 4,
+            },
+        )
+        sys.stderr.write("\n" + run.render(verbose=True) + "\n")
+        # zero invariant violations
+        assert run.ok, run.render(verbose=True)
+        slo = run.slo
+        # populated SLO report: non-null latency percentiles
+        assert slo["eval_latency_ms"]["count"] > 500
+        assert slo["eval_latency_ms"]["p99_ms"] > 0
+        assert slo["placement_latency_ms"]["p99_ms"] > 0
+        # breaker/fallback/lane counters present (values are load-
+        # dependent; the keys and the zero-trip expectation are not)
+        assert slo["counters"]["breaker_trips"] == 0
+        assert slo["counters"]["fallback_activations"] == 0
+        assert slo["counters"]["lane_conflicts"] == 0
+        # verdict present and computed
+        assert isinstance(slo["verdict"]["pass"], bool)
+        # node churn happened during the soak
+        assert run.workload["drains"] > 0
+        assert run.workload["flaps"] > 0
+        # saturation search produced a rate
+        assert run.saturation_rate is not None
+        assert run.saturation_rate > 0
+        # schema still pinned at scale
+        assert slo_schema_of(slo) == SLO_SCHEMA

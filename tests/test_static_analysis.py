@@ -641,7 +641,7 @@ class TestBaselineRatchet:
     def test_registry_covers_all_rules(self):
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
-            "NTA007", "NTA008", "NTA009", "NTA010",
+            "NTA007", "NTA008", "NTA009", "NTA010", "NTA011",
         ]
 
 
