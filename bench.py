@@ -627,7 +627,39 @@ def bench_soak(argv: list, batch_workers: int) -> dict:
     )
     p.add_argument("--sat-probe-seconds", type=float, default=2.0)
     p.add_argument("--sat-nodes", type=int, default=200)
+    p.add_argument(
+        "--overload", action="store_true",
+        help="admission-control acceptance run: find the saturation "
+        "rate, then replay a burst soak spiking past it and demand the "
+        "high-priority SLO holds while lower tiers are deferred/shed",
+    )
+    p.add_argument(
+        "--overload-factor", type=float, default=2.0,
+        help="spike arrival rate as a multiple of the measured "
+        "saturation rate (default 2.0)",
+    )
+    p.add_argument("--spike-rate", type=float, default=0.0)
+    p.add_argument("--spike-start", type=float, default=0.0)
+    p.add_argument("--spike-seconds", type=float, default=0.0)
+    p.add_argument(
+        "--priority-mix", type=str, default=None,
+        help="arrival priority weights as prio:weight pairs, e.g. "
+        "'30:0.3,50:0.4,70:0.3' (default: uniform 30/50/70)",
+    )
+    p.add_argument(
+        "--high-p99-ms", type=float, default=5000.0,
+        help="high-tier p99 eval-latency bound enforced in --overload "
+        "mode (the SLO the admission plane defends)",
+    )
     args = p.parse_args(argv)
+    mix = None
+    if args.priority_mix:
+        mix = {
+            int(pair.split(":")[0]): float(pair.split(":")[1])
+            for pair in args.priority_mix.split(",")
+        }
+    if args.overload:
+        return _bench_soak_overload(args, batch_workers, mix)
     run = run_soak(
         seed=args.seed,
         seconds=args.seconds,
@@ -639,8 +671,104 @@ def bench_soak(argv: list, batch_workers: int) -> dict:
             "probe_seconds": args.sat_probe_seconds,
             "nodes": args.sat_nodes,
         },
+        spike_rate=args.spike_rate,
+        spike_start=args.spike_start,
+        spike_seconds=args.spike_seconds,
+        priority_mix=mix,
     )
     return run.to_dict()
+
+
+def _bench_soak_overload(args, batch_workers: int, mix) -> dict:
+    """`bench.py soak --overload` — the overload acceptance gate.
+
+    Measures the sustainable arrival rate first (same binary search as
+    --saturation), then runs a soak whose middle third spikes to
+    ``--overload-factor``× that rate with tightened admission
+    thresholds so the controller must engage. The verdict is the
+    admission plane's contract, not raw throughput: high-tier p99
+    within --high-p99-ms, shedding confined to the lowest priority
+    tier present, the per-tier conservation law intact, and the
+    controller back at NORMAL once the spike drains.
+    """
+    from nomad_tpu.obs.loadgen import run_soak, saturation_search
+    from nomad_tpu.obs.slo import SloTargets
+
+    sat = saturation_search(
+        seed=args.seed,
+        nodes=args.sat_nodes,
+        batch_workers=batch_workers,
+        probe_seconds=args.sat_probe_seconds,
+    )
+    spike_rate = args.overload_factor * sat
+    run = run_soak(
+        seed=args.seed,
+        seconds=args.seconds,
+        # base load just under saturation; the spike stream carries the
+        # overload so the pre/post-spike phases exercise recovery
+        rate=0.9 * sat,
+        nodes=args.sat_nodes,
+        batch_workers=batch_workers,
+        # only the high-tier bound: general latency/queue targets are
+        # expected casualties of a deliberate 2x-saturation spike
+        targets=SloTargets(
+            eval_p99_ms=None,
+            high_eval_p99_ms=args.high_p99_ms,
+            placement_p99_ms=None,
+            queue_depth_max=None,
+            max_breaker_trips=None,
+            max_fallback_activations=None,
+            max_lane_conflicts=None,
+        ),
+        spike_rate=spike_rate,
+        spike_start=args.seconds / 3.0,
+        spike_seconds=args.seconds / 3.0,
+        priority_mix=mix or {30: 0.3, 50: 0.4, 70: 0.3},
+        # thresholds sized to the probe-scale cluster so the controller
+        # engages within the spike window instead of at datacenter scale
+        admission_overrides={
+            "brownout_backlog": 32,
+            "shed_backlog": 128,
+            "brownout_p99_ms": 1000.0,
+            "shed_p99_ms": 4000.0,
+            "min_p99_samples": 8,
+            "reeval_interval_s": 0.1,
+            "dwell_s": 1.0,
+            "defer_delay_s": 0.5,
+        },
+    )
+    d = run.to_dict()
+    adm = run.admission or {}
+    counters = adm.get("counters", {})
+    present = [
+        t for t in ("low", "normal", "high")
+        if counters.get(t, {}).get("submitted")
+    ]
+    lowest = present[0] if present else None
+    shed_confined = all(
+        c["shed"] == 0 for t, c in counters.items() if t != lowest
+    )
+    verdict_failures = run.slo["verdict"]["failures"]
+    high_ok = not any(
+        f.startswith("high_eval_p99_ms") for f in verdict_failures
+    )
+    d["overload"] = {
+        "saturation_rate": sat,
+        "spike_rate": spike_rate,
+        "factor": args.overload_factor,
+        "engaged": bool(adm.get("level_changes")),
+        "high_slo_ok": high_ok,
+        "shed_confined_to_lowest": shed_confined,
+        "lowest_tier_present": lowest,
+        "conserved": bool(adm.get("conserved")),
+        "recovered": bool(adm.get("recovered")),
+    }
+    o = d["overload"]
+    d["overload"]["ok"] = (
+        o["engaged"] and o["high_slo_ok"] and o["shed_confined_to_lowest"]
+        and o["conserved"] and o["recovered"]
+    )
+    return d
 
 
 def main():
@@ -666,7 +794,7 @@ def main():
                 }
             )
         )
-        if not d["ok"]:
+        if not d["ok"] or not d.get("overload", {"ok": True})["ok"]:
             sys.exit(1)
         return
     if len(sys.argv) > 1 and sys.argv[1] == "grid":

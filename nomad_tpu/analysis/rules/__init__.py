@@ -13,6 +13,7 @@ from .wallclock import BareWallClockInBrokerServer  # noqa: E402
 from .blocking import BlockingWithoutTimeout  # noqa: E402
 from .laneowner import LaneOwnerDiscipline  # noqa: E402
 from .accumulation import UnboundedAccumulation  # noqa: E402
+from .admissiongate import AdmissionGateDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -26,6 +27,7 @@ REGISTRY = [
     BlockingWithoutTimeout,  # NTA009
     LaneOwnerDiscipline,  # NTA010
     UnboundedAccumulation,  # NTA011
+    AdmissionGateDiscipline,  # NTA012
 ]
 
 __all__ = ["REGISTRY"]

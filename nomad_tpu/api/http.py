@@ -22,6 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from ..server.admission import AdmissionRejected
 from ..server.fsm import MsgType
 from ..structs import Evaluation, new_id
 from ..structs.job import JOB_DEFAULT_PRIORITY
@@ -29,10 +30,11 @@ from .codec import _decode_into, decode_job, encode
 
 
 class APIError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str, headers: Optional[dict] = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = headers
 
 
 class StreamingResponse:
@@ -283,7 +285,22 @@ class HTTPAgent:
                                 method, body, query, **m.groupdict()
                             )
                         except APIError as e:
-                            self._reply(e.status, {"error": e.message})
+                            self._reply(
+                                e.status, {"error": e.message}, headers=e.headers
+                            )
+                        except AdmissionRejected as e:
+                            # overload: the controller refused the work
+                            # before anything was committed — tell the
+                            # client when to come back (RFC 6585)
+                            self._reply(
+                                429,
+                                {
+                                    "error": str(e),
+                                    "admission_level": e.level,
+                                    "retry_after": e.retry_after,
+                                },
+                                headers={"Retry-After": f"{e.retry_after:g}"},
+                            )
                         except Exception as e:  # noqa: BLE001
                             self._reply(500, {"error": str(e)})
                         else:
@@ -294,7 +311,7 @@ class HTTPAgent:
                         return
                 self._reply(404, {"error": f"no handler for {parsed.path}"})
 
-            def _reply(self, status, payload):
+            def _reply(self, status, payload, headers=None):
                 data = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -302,6 +319,8 @@ class HTTPAgent:
                 self.send_header(
                     "X-Nomad-Index", str(agent.server.store.latest_index)
                 )
+                for name, value in (headers or {}).items():
+                    self.send_header(name, str(value))
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -912,6 +931,10 @@ class HTTPAgent:
         from ..structs import Evaluation
         from ..structs.evaluation import EVAL_STATUS_PENDING
 
+        # admission gate BEFORE the eval is committed: apply_eval_create
+        # is shared with internal worker followups and must stay
+        # ungated, so the external trigger checks in explicitly here
+        self.server.admission.check_intake(job.priority, "job-eval")
         ev = Evaluation(
             namespace=ns,
             priority=job.priority,
@@ -1448,12 +1471,18 @@ class HTTPAgent:
                 },
                 "claims": srv.lane_claims.snapshot(),
             },
+            "admission": (
+                srv.admission.snapshot()
+                if getattr(srv, "admission", None) is not None
+                else None
+            ),
             "counters": {
                 k: v
                 for k, v in counters.items()
                 if k.startswith("nomad.resilience.")
                 or k.startswith("nomad.plan.lane_")
                 or k.startswith("nomad.worker.lane_")
+                or k.startswith("nomad.admission.")
                 or k == "nomad.plan.cross_lane_handoffs"
                 or k == "nomad.broker.nack_redelivery_delayed"
             },

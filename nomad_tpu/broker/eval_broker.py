@@ -63,9 +63,16 @@ class EvalBroker:
         n_partitions: int = 1,
         unack_timeout: Optional[float] = DEFAULT_UNACK_TIMEOUT,
         clock=None,
+        admission=None,
     ):
         self._lock = threading.Condition()
         self.enabled = False
+        # overload gate (server/admission.py AdmissionController, set by
+        # the composition root): consulted on every enqueue with the
+        # backlog depth the broker already holds, so over-watermark
+        # external evals park on the delayed heap instead of piling
+        # into ready. None (unit tests, standalone brokers) = no gate.
+        self.admission = admission
         # injectable wall clock (the GenericScheduler clock= pattern,
         # NTA008): delay-heap firing times and unack redelivery
         # deadlines all read it, so chaos clock-skew faults reach the
@@ -116,6 +123,7 @@ class EvalBroker:
             "acks": 0,
             "nacks": 0,
             "unack_timeouts": 0,
+            "admission_deferred": 0,
             "chaos_dup_enqueues": 0,
             "chaos_dropped_deliveries": 0,
         }
@@ -160,6 +168,22 @@ class EvalBroker:
         # stamp first readiness (delayed evals stamp when they fire; the
         # job-gate defer still counts — that IS queue wait for the job)
         self._enqueued_at.setdefault(ev.id, now)
+        # per-priority admission watermarks: past the brownout point,
+        # externally-submitted evals whose tier watermark is below the
+        # active backlog park on the delayed heap and re-decide when
+        # they fire (each pass is one conservation-counted decision).
+        # Liveness traffic is exempt inside the gate; a committed eval
+        # is only ever DEFERRED here, never dropped (law 7).
+        adm = self.admission
+        if adm is not None:
+            backlog = len(self._unack) + sum(
+                len(q) for t, q in self._ready.items() if t != FAILED_QUEUE
+            )
+            delay = adm.gate_enqueue(ev, backlog)
+            if delay is not None:
+                self.counters["admission_deferred"] += 1
+                heapq.heappush(self._delayed, (now + delay, next(self._seq), ev))
+                return
         job_key = (ev.namespace, ev.job_id)
         if not ignore_job_gate and job_key in self._in_flight_jobs:
             self._pending_by_job.setdefault(job_key, _PQ()).push(ev)

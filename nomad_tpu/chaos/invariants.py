@@ -42,6 +42,11 @@ true no matter which faults fired:
     (``nomad.overlay.cross_lane_writes``), and the claim table drained
     (no leaked reservations after quiesce). Handoffs themselves are
     fine and counted separately (``nomad.plan.cross_lane_handoffs``).
+``admission_conservation``
+    the admission controller's per-tier decision ledger balances:
+    ``admitted + deferred + shed == submitted`` for every priority
+    tier — no decision is lost or double-counted, even through
+    ``admission.flap`` forced-level windows (server/admission.py).
 """
 
 from __future__ import annotations
@@ -65,6 +70,7 @@ INVARIANTS = (
     "job_conservation",
     "eval_terminal",
     "lane_isolation",
+    "admission_conservation",
 )
 
 
@@ -370,6 +376,29 @@ def check_cluster(
             )
         report.info["lanes"] = claims.snapshot()
 
+    # -- admission_conservation --------------------------------------------
+    # Law 10: the admission controller's per-tier decision ledger must
+    # balance — every submitted decision resolved as exactly one of
+    # admitted, deferred, or shed. Per-server counters, so no baseline
+    # is needed; checked whenever the controller exists, including
+    # through admission.flap forced-level windows.
+    adm = getattr(server, "admission", None)
+    if adm is not None:
+        report.checked["admission_conservation"] = True
+        adm_counters = adm.counters()
+        for tier in sorted(adm_counters):
+            c2 = adm_counters[tier]
+            resolved = c2["admitted"] + c2["deferred"] + c2["shed"]
+            if resolved != c2["submitted"]:
+                report._fail(
+                    "admission_conservation",
+                    f"tier:{tier}",
+                    f"submitted={c2['submitted']} != "
+                    f"admitted={c2['admitted']} + deferred={c2['deferred']} "
+                    f"+ shed={c2['shed']}",
+                )
+        report.info["admission"] = adm.snapshot()
+
     # context for the human-facing dump
     from ..resilience.breaker import snapshot_all
 
@@ -381,6 +410,7 @@ def check_cluster(
         if k.startswith((
             "nomad.chaos.", "nomad.resilience.", "nomad.lane.",
             "nomad.overlay.", "nomad.plan.lane", "nomad.plan.cross_lane",
+            "nomad.admission.",
         ))
         or k == "nomad.broker.nack_redelivery_delayed"
         or k.endswith(".swallowed_errors")

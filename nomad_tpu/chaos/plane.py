@@ -74,9 +74,15 @@ SITES: dict[str, tuple[str, ...]] = {
     # handoff must still settle/release via the worker's finally
     "lane.handoff_drop": ("drop", "kill"),
     "lane.handoff_delay": ("delay",),
+    # admission controller (server/admission.py): force the overload
+    # level to SHED for a bounded window mid-run — shed accounting
+    # (invariant law 10) and NORMAL recovery must survive the flapping
+    "admission.flap": ("force",),
 }
 
-FAULT_KINDS = ("raise", "delay", "duplicate", "drop", "kill", "skew", "hang")
+FAULT_KINDS = (
+    "raise", "delay", "duplicate", "drop", "kill", "skew", "hang", "force",
+)
 
 # Expected effective-call budget per site for a `steps`-op workload,
 # as a fraction of steps (with a floor). Fault indices are sampled
@@ -97,6 +103,8 @@ _HORIZON = {
     "rpc.conn_drop": (0.25, 2),
     "lane.handoff_drop": (0.25, 2),
     "lane.handoff_delay": (0.25, 2),
+    # hit once per controller re-eval tick, not per workload op
+    "admission.flap": (0.5, 4),
 }
 
 
@@ -280,7 +288,8 @@ class FaultPlane:
             with self._lock:
                 self.kills += 1
             raise ChaosThreadKill(site, n)
-        return action  # "drop" / "duplicate": the site decides what it means
+        # "drop" / "duplicate" / "force": the site decides what it means
+        return action
 
     def ledger_commit(self, alloc_ids) -> None:
         with self._lock:

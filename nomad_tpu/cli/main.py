@@ -712,6 +712,29 @@ def cmd_resilience_status(args) -> int:
                 f"rejected={cc.get('confirm_rejected', 0)} "
                 f"active={claims.get('active_claims', 0)}"
             )
+    adm = out.get("admission")
+    if adm:
+        sig = adm.get("signals") or {}
+        print(
+            f"\nadmission: level={adm['level']} "
+            f"since={adm.get('since_s', 0.0):.1f}s "
+            f"changes={adm.get('level_changes', 0)}"
+            + (" (forced)" if adm.get("forced") else "")
+        )
+        print(
+            f"  signals: backlog={sig.get('backlog', 0)} "
+            f"p99={sig.get('p99_ms', 0.0):.1f}ms "
+            f"arrival={sig.get('arrival_rate', 0.0):.1f}/s "
+            f"completion={sig.get('completion_rate', 0.0):.1f}/s"
+        )
+        for tier in ("high", "normal", "low"):
+            c = (adm.get("counters") or {}).get(tier)
+            if c and c.get("submitted"):
+                print(
+                    f"  {tier:<7} submitted={c['submitted']} "
+                    f"admitted={c['admitted']} deferred={c['deferred']} "
+                    f"shed={c['shed']}"
+                )
     counters = out.get("counters", {})
     if counters:
         print("\ncounters:")

@@ -73,11 +73,36 @@ def build_schedule(
     stop_frac: float = 0.1,
     drain_rate: float = 0.05,
     flap_rate: float = 0.05,
+    spike_rate: float = 0.0,
+    spike_start: float = 0.0,
+    spike_seconds: float = 0.0,
+    priority_mix: Optional[dict] = None,
 ) -> list[SoakEvent]:
     """Deterministic soak timeline. Independent seeded streams per
     event family (the chaos plane's per-site rng pattern) keep each
-    family's draws stable when another family's knob changes."""
+    family's draws stable when another family's knob changes.
+
+    ``spike_rate > 0`` layers a burst arrival stream (its own
+    ``{seed}:spike`` rng) on the Poisson base during
+    ``[spike_start, spike_start + spike_seconds)`` — the reproducible
+    overload scenario. ``priority_mix`` maps priority → weight for
+    arrival priorities (both streams); ``None`` keeps the classic
+    uniform 30/50/70 draw byte-identical to earlier releases."""
     events: list[SoakEvent] = []
+
+    if priority_mix:
+        # keys may arrive as strings (JSON / --priority-mix on the CLI)
+        _by_prio = {int(p): float(w) for p, w in priority_mix.items()}
+        _prios = tuple(sorted(_by_prio))
+        _weights = [_by_prio[p] for p in _prios]
+
+        def _prio(rng: random.Random) -> int:
+            return rng.choices(_prios, weights=_weights)[0]
+
+    else:
+
+        def _prio(rng: random.Random) -> int:
+            return rng.choice((30, 50, 70))
 
     arr = random.Random(f"{seed}:arrivals")
     t = 0.0
@@ -90,7 +115,7 @@ def build_schedule(
             SoakEvent(
                 t, "arrive", seq,
                 count=arr.randint(1, 3),
-                priority=arr.choice((30, 50, 70)),
+                priority=_prio(arr),
             )
         )
         seq += 1
@@ -130,6 +155,26 @@ def build_schedule(
             events.append(SoakEvent(t, kind, idx))
             events.append(SoakEvent(t + dur, restore, idx))
 
+    # burst stream LAST so the base arrivals, churn targeting, and node
+    # streams above draw identically whether or not a spike is layered
+    # on (same per-family isolation the chaos plane guarantees)
+    if spike_rate > 0 and spike_seconds > 0:
+        spike = random.Random(f"{seed}:spike")
+        spike_end = min(seconds, spike_start + spike_seconds)
+        t = spike_start
+        while True:
+            t += spike.expovariate(spike_rate)
+            if t >= spike_end:
+                break
+            events.append(
+                SoakEvent(
+                    t, "arrive", seq,
+                    count=spike.randint(1, 3),
+                    priority=_prio(spike),
+                )
+            )
+            seq += 1
+
     events.sort(key=lambda e: (e.t, e.kind, e.target))
     return events
 
@@ -151,6 +196,7 @@ class SoakRun:
         workload: dict,
         duration_s: float,
         saturation_rate: Optional[float] = None,
+        admission: Optional[dict] = None,
     ):
         self.seed = seed
         self.seconds = seconds
@@ -164,6 +210,9 @@ class SoakRun:
         self.workload = workload
         self.duration_s = duration_s
         self.saturation_rate = saturation_rate
+        # measured controller snapshot + recovered/conserved flags
+        # (diagnostics — never part of canonical())
+        self.admission = admission
 
     @property
     def ok(self) -> bool:
@@ -195,6 +244,7 @@ class SoakRun:
         d["slo"] = self.slo
         d["saturation_rate"] = self.saturation_rate
         d["invariants"] = self.report.to_dict()
+        d["admission"] = self.admission
         d["workload"] = dict(self.workload)
         d["duration_s"] = round(self.duration_s, 3)
         d["ok"] = self.ok
@@ -237,6 +287,19 @@ class SoakRun:
             f"{k}={int(ctr[k])}" for k in sorted(ctr) if ctr[k]
         )
         lines.append("counters       " + (nonzero or "(all zero)"))
+        if self.admission is not None:
+            tiers = self.admission.get("counters", {})
+            decided = " ".join(
+                f"{tier}={c['admitted']}/{c['deferred']}/{c['shed']}"
+                for tier, c in sorted(tiers.items())
+                if c["submitted"]
+            )
+            lines.append(
+                f"admission      level={self.admission.get('level')} "
+                f"recovered={self.admission.get('recovered')} "
+                f"conserved={self.admission.get('conserved')} "
+                + (f"adm/def/shed {decided}" if decided else "(no decisions)")
+            )
         if self.saturation_rate is not None:
             lines.append(f"saturation_rate {self.saturation_rate:g}/s")
         lines.append("invariants:")
@@ -282,6 +345,7 @@ def _build_job(seq: int, count: int, priority: int):
 
 
 def _apply_event(server, ev: SoakEvent, node_ids: list[str], counts: dict):
+    from ..server.admission import AdmissionRejected
     from ..structs.node import DrainStrategy
 
     try:
@@ -309,6 +373,12 @@ def _apply_event(server, ev: SoakEvent, node_ids: list[str], counts: dict):
         elif ev.kind == "up":
             server.update_node_status(node_id, "ready")
         return False
+    except AdmissionRejected:
+        # overload pushback (429-equivalent): the submission never
+        # entered the cluster — counted separately from plain rejects
+        # so the overload soak can assert the throttle actually fired
+        counts["throttled"] += 1
+        return False
     except Exception:
         # a stop against a never-registered job or a drain racing a
         # deregister: real clients see the same errors and move on
@@ -330,6 +400,11 @@ def run_soak(
     quiesce_timeout: float = 60.0,
     saturation: bool = False,
     saturation_kwargs: Optional[dict] = None,
+    spike_rate: float = 0.0,
+    spike_start: float = 0.0,
+    spike_seconds: float = 0.0,
+    priority_mix: Optional[dict] = None,
+    admission_overrides: Optional[dict] = None,
 ) -> SoakRun:
     """One full soak cycle: boot, seed fleet, replay the schedule on
     the wall clock, quiesce, check invariants, build the SLO report."""
@@ -340,6 +415,8 @@ def run_soak(
         seed, seconds, rate, nodes,
         update_frac=update_frac, stop_frac=stop_frac,
         drain_rate=drain_rate, flap_rate=flap_rate,
+        spike_rate=spike_rate, spike_start=spike_start,
+        spike_seconds=spike_seconds, priority_mix=priority_mix,
     )
     baseline = metrics_baseline()
     t_start = time.perf_counter()
@@ -350,6 +427,7 @@ def run_soak(
             # no clients heartbeat in-process; node liveness is driven
             # by the schedule's down/up events instead
             heartbeat_ttl=3600.0,
+            admission_overrides=admission_overrides,
         )
     )
     broker = server.eval_broker
@@ -358,7 +436,7 @@ def run_soak(
     broker.initial_nack_delay = RUN_INITIAL_NACK_DELAY
     counts = {
         "arrivals": 0, "updates": 0, "stops": 0,
-        "drains": 0, "flaps": 0, "rejected": 0,
+        "drains": 0, "flaps": 0, "rejected": 0, "throttled": 0,
     }
     collector = SloCollector()
     report: InvariantReport
@@ -404,11 +482,29 @@ def run_soak(
                 seen.add((r.kind, r.target))
                 _apply_event(server, r, node_ids, counts)
             quiesced = _quiesce(server, quiesce_timeout)
+            # bounded-recovery check: after the traffic (and any spike)
+            # ends and the queue drains, the controller must step back
+            # to NORMAL within the p99 window's retention (spike-era
+            # samples keep voting for up to 2x window_s after drain)
+            # plus one dwell per level and slack
+            adm = server.admission
+            win = getattr(adm._p99_window, "window_s", 0.0) or 0.0
+            recovery_deadline = time.perf_counter() + (
+                2.0 * win + 3.0 * adm.dwell_s + 2.0
+            )
+            recovered = adm.level(force=True) == "normal"
+            while not recovered and time.perf_counter() < recovery_deadline:
+                time.sleep(0.05)
+                recovered = adm.level(force=True) == "normal"
+            admission = adm.snapshot()
+            admission["recovered"] = recovered
+            admission["conserved"] = adm.conserved()
         finally:
             collector.stop()
         report = check_cluster(server, plane=None, baseline=baseline)
         report.info["quiesced"] = quiesced
         report.info["batch_workers"] = batch_workers
+        report.info["admission_recovered"] = recovered
         if not quiesced:
             report._fail(
                 "eval_terminal",
@@ -442,6 +538,7 @@ def run_soak(
         workload=counts,
         duration_s=time.perf_counter() - t_start,
         saturation_rate=sat,
+        admission=admission,
     )
 
 

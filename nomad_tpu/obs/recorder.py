@@ -82,6 +82,16 @@ class FlightRecorder:
             global_metrics.incr("nomad.obs.traces_evicted", evicted)
         eval_s, placement_s = trace_latencies(trace)
         global_metrics.measure("nomad.slo.eval_latency", eval_s)
+        # high-priority tier gets its own always-on series: the
+        # admission plane promises this one stays within SLO while
+        # lower tiers are deferred/shed, so it must be observable
+        # lifetime (live_report) not just per-collector
+        priority = (trace.get("tags") or {}).get("priority")
+        if priority is not None:
+            from ..server.admission import TIER_HIGH, tier_of
+
+            if tier_of(int(priority)) == TIER_HIGH:
+                global_metrics.measure("nomad.slo.eval_latency_high", eval_s)
         if placement_s > 0.0:
             global_metrics.measure("nomad.slo.placement_latency", placement_s)
         for fn in listeners:

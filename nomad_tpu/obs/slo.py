@@ -54,6 +54,8 @@ REPORT_COUNTERS = {
     "unack_timeouts": "nomad.broker.unack_timeouts",
     "deadline_nacks": "nomad.resilience.eval.deadline_nacks",
     "traces_evicted": "nomad.obs.traces_evicted",
+    "admission_deferred": "nomad.admission.deferred_total",
+    "admission_shed": "nomad.admission.shed_total",
 }
 
 _LATENCY_KEYS = (
@@ -65,6 +67,7 @@ _LATENCY_KEYS = (
 # so it belongs in the canonical block of a soak report.
 SLO_SCHEMA = tuple(sorted(
     [f"eval_latency_ms.{k}" for k in _LATENCY_KEYS]
+    + [f"eval_latency_high_ms.{k}" for k in _LATENCY_KEYS]
     + [f"placement_latency_ms.{k}" for k in _LATENCY_KEYS]
     + [f"plan_apply_ms.{k}" for k in _LATENCY_KEYS]
     + [
@@ -100,7 +103,8 @@ class SloTargets:
     compared against the measured window in :func:`verdict`."""
 
     FIELDS = (
-        "eval_p99_ms", "placement_p99_ms", "queue_depth_max",
+        "eval_p99_ms", "high_eval_p99_ms", "placement_p99_ms",
+        "queue_depth_max",
         "max_breaker_trips", "max_fallback_activations",
         "max_lane_conflicts", "max_unack_timeouts",
         "max_swallowed_errors", "min_completion_ratio",
@@ -109,6 +113,7 @@ class SloTargets:
     def __init__(
         self,
         eval_p99_ms: Optional[float] = 5000.0,
+        high_eval_p99_ms: Optional[float] = None,
         placement_p99_ms: Optional[float] = 2500.0,
         queue_depth_max: Optional[float] = 10000.0,
         max_breaker_trips: Optional[float] = 0.0,
@@ -119,6 +124,9 @@ class SloTargets:
         min_completion_ratio: Optional[float] = None,
     ):
         self.eval_p99_ms = eval_p99_ms
+        # the overload acceptance bar: high-tier eval latency must hold
+        # even while lower tiers are being deferred/shed
+        self.high_eval_p99_ms = high_eval_p99_ms
         self.placement_p99_ms = placement_p99_ms
         self.queue_depth_max = queue_depth_max
         self.max_breaker_trips = max_breaker_trips
@@ -151,6 +159,9 @@ class SloTargets:
         pl = slo["placement_latency_ms"]
         if ev["count"]:
             _over("eval_p99_ms", ev["p99_ms"], self.eval_p99_ms)
+        hi = slo.get("eval_latency_high_ms")
+        if hi and hi["count"]:
+            _over("high_eval_p99_ms", hi["p99_ms"], self.high_eval_p99_ms)
         if pl["count"]:
             _over(
                 "placement_p99_ms", pl["p99_ms"], self.placement_p99_ms
@@ -206,6 +217,10 @@ class SloCollector:
         self.period = period
         self._lock = threading.Lock()
         self.eval_hist = LogHistogram()
+        # high-priority tier only (tier_of(priority) == "high", from the
+        # worker's priority trace tag): the overload story promises this
+        # histogram stays within SLO while lower tiers shed
+        self.eval_high_hist = LogHistogram()
         self.placement_hist = LogHistogram()
         self.queue_ring = TimeSeriesRing(window_seconds)
         self.arrival_ring = TimeSeriesRing(window_seconds)
@@ -232,8 +247,16 @@ class SloCollector:
     def _on_trace(self, trace: dict) -> None:
         eval_s, placement_s = trace_latencies(trace)
         now = self._clock()
+        priority = (trace.get("tags") or {}).get("priority")
+        is_high = False
+        if priority is not None:
+            from ..server.admission import TIER_HIGH, tier_of
+
+            is_high = tier_of(int(priority)) == TIER_HIGH
         with self._lock:
             self.eval_hist.record(eval_s)
+            if is_high:
+                self.eval_high_hist.record(eval_s)
             if placement_s > 0.0:
                 self.placement_hist.record(placement_s)
             self.completions += 1
@@ -295,6 +318,7 @@ class SloCollector:
         hists = self._metrics.histograms()
         with self._lock:
             eval_hist = self.eval_hist.copy()
+            eval_high_hist = self.eval_high_hist.copy()
             placement_hist = self.placement_hist.copy()
             q = self.queue_ring.stats(now)
             arrivals = self.arrivals
@@ -323,6 +347,7 @@ class SloCollector:
         evicted = self._recorder.traces_evicted - self._traces_base[1]
         return {
             "eval_latency_ms": eval_hist.snapshot(),
+            "eval_latency_high_ms": eval_high_hist.snapshot(),
             "placement_latency_ms": placement_hist.snapshot(),
             "plan_apply_ms": (
                 plan.snapshot() if plan is not None
@@ -373,9 +398,12 @@ def live_report(server, targets: Optional[SloTargets] = None) -> dict:
     collector.sample_once()
     hists = global_metrics.histograms()
     ev = hists.get("nomad.slo.eval_latency")
+    hi = hists.get("nomad.slo.eval_latency_high")
     pl = hists.get("nomad.slo.placement_latency")
     if ev is not None:
         collector.eval_hist = ev
+    if hi is not None:
+        collector.eval_high_hist = hi
     if pl is not None:
         collector.placement_hist = pl
     collector.completions = collector.eval_hist.count

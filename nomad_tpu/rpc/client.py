@@ -9,6 +9,10 @@ a connection that died before the reply — only methods registered as
 idempotent (:data:`DEFAULT_IDEMPOTENT` plus :meth:`RPCClient.mark_idempotent`)
 are retried; everything else, plan/job submission above all, stays
 at-most-once and surfaces the ``ConnectionError`` to the caller.
+Admission throttling is the exception: a :class:`RPCThrottled` reply
+means the server refused the request before executing it, so every
+method retries, sleeping the server's ``Retry-After`` hint (or the
+normal backoff if longer).
 
 Reference: helper/pool (ConnPool — the server-to-server connection pool,
 nomad/rpc.go uses it for forwarding) and client/rpc.go (client→server
@@ -46,6 +50,19 @@ DEFAULT_IDEMPOTENT = frozenset({
 
 class RPCError(Exception):
     """Error raised by the remote handler (crossed the wire)."""
+
+
+class RPCThrottled(RPCError):
+    """Remote admission control refused the request (429-equivalent).
+
+    Carries the server's ``Retry-After`` hint in seconds. A throttled
+    request was rejected BEFORE execution, so retrying it is safe for
+    every method — idempotent or not — and the client honors the hint
+    in its backoff."""
+
+    def __init__(self, message: str, retry_after: float):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
 
 
 class _Conn:
@@ -158,6 +175,15 @@ class RPCClient:
         )
         self._sleep(delay * self._rng.uniform(0.5, 1.5))
 
+    def _throttle_sleep(self, retry_after: float, attempt: int) -> None:
+        from ..utils.metrics import global_metrics
+
+        global_metrics.incr("nomad.admission.rpc_throttled_retries")
+        backoff = min(
+            self.backoff_cap, self.backoff_base * (2.0 ** (attempt - 1))
+        )
+        self._sleep(max(float(retry_after), backoff) * self._rng.uniform(1.0, 1.25))
+
     def _send(
         self, conn: _Conn, method: str, args: Any
     ) -> tuple[_Conn, int, queue.Queue]:
@@ -196,6 +222,8 @@ class RPCClient:
         if "error" in msg:
             if msg["error"] == "connection closed":
                 raise ConnectionError(f"rpc {method}: connection closed")
+            if "retry_after" in msg:
+                raise RPCThrottled(msg["error"], msg["retry_after"])
             raise RPCError(msg["error"])
         return msg.get("result")
 
@@ -217,6 +245,15 @@ class RPCClient:
                 continue
             try:
                 return self._call_once(conn, method, args, timeout)
+            except RPCThrottled as e:
+                # server-side admission refusal: the request never
+                # executed, so EVERY method retries — honoring the
+                # server's Retry-After over our own backoff when it's
+                # longer (jittered so a shed wave doesn't resync)
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    raise
+                self._throttle_sleep(e.retry_after, attempt)
             except ConnectionError:
                 # the request may have executed remotely: at-most-once
                 # unless the method is registered idempotent

@@ -152,7 +152,14 @@ class RPCServer:
             pass  # peer went away mid-reply
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             log.debug("rpc handler %s failed", method, exc_info=True)
+            payload = {"error": f"{type(e).__name__}: {e}"}
+            # admission throttling (server/admission.py AdmissionRejected
+            # or anything else carrying retry_after): ship the hint so
+            # the client can honor it in its backoff
+            retry_after = getattr(e, "retry_after", None)
+            if retry_after is not None:
+                payload["retry_after"] = float(retry_after)
             try:
-                reply({"error": f"{type(e).__name__}: {e}"})
+                reply(payload)
             except OSError:
                 pass

@@ -59,6 +59,7 @@ class ServerConfig:
         clock=None,
         eval_deadline: Optional[float] = None,
         eval_attempt_limit: Optional[int] = None,
+        admission_overrides: Optional[dict] = None,
     ):
         import os
 
@@ -107,6 +108,10 @@ class ServerConfig:
         self.lane_mode = (
             self.num_batch_workers > 1 if lane_mode is None else bool(lane_mode)
         )
+        # threshold/dwell overrides for the admission controller
+        # (server/admission.py); None keeps the production defaults,
+        # under which NORMAL behavior is identical to pre-admission.
+        self.admission_overrides = admission_overrides
 
 
 class Server:
@@ -132,6 +137,22 @@ class Server:
             clock=clock.time if clock is not None else None,
         )
         self.blocked_evals = BlockedEvals(broker=self.eval_broker)
+        # overload protection (server/admission.py): one controller per
+        # server, fed by the broker's own depth/ack counters and the
+        # always-on eval-latency histogram; handed to the broker so its
+        # enqueue gate can defer over-watermark external evals.
+        from .admission import AdmissionController, HistWindow
+
+        self.admission = AdmissionController(
+            clock=clock.monotonic if clock is not None else None,
+            depth_fn=self.eval_broker.queue_depths,
+            p99_window=HistWindow(
+                clock=clock.monotonic if clock is not None else None
+            ),
+            completions_fn=lambda: self.eval_broker.counters["acks"],
+            **(self.config.admission_overrides or {}),
+        )
+        self.eval_broker.admission = self.admission
         self.plan_queue = PlanQueue()
         self.plan_apply_loop = PlanApplyLoop(
             self.store, self.plan_queue,
@@ -256,6 +277,9 @@ class Server:
         tg = job.lookup_task_group(group)
         if tg is None:
             raise KeyError(f"group not found: {group}")
+        from ..structs.evaluation import TRIGGER_JOB_SCALING
+
+        self.admission.check_intake(job.priority, TRIGGER_JOB_SCALING)
         if tg.scaling is not None and tg.scaling.enabled:
             if count < tg.scaling.min or (
                 tg.scaling.max and count > tg.scaling.max
@@ -400,6 +424,10 @@ class Server:
         """Job.Register (nomad/job_endpoint.go): upsert job + create eval
         in one commit, then enqueue."""
         validate_job(job)
+        # overload gate BEFORE any state commit: a shed register raises
+        # AdmissionRejected (HTTP: 429 + Retry-After) with nothing
+        # written, so job/eval conservation laws never see it
+        self.admission.check_intake(job.priority, TRIGGER_JOB_REGISTER)
         # periodic/parameterized jobs are templates: no eval until a child
         # is derived (job_endpoint.go Register skips eval creation for them)
         needs_eval = not job.is_periodic() and not job.is_parameterized()

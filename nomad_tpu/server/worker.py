@@ -305,11 +305,20 @@ class Worker:
             # pre-trace interval: no eval (hence no trace) exists until the
             # dequeue returns — the sample feeds /v1/metrics directly and
             # the span is attached retroactively per dequeued eval below
+            # brownout lever: past the brownout point the batch worker
+            # widens its dequeue window (bigger batch, longer wait) so
+            # each device pass amortizes more evals instead of
+            # thrashing small kernel invocations; NORMAL keeps the
+            # baseline 16/0.2 exactly.
+            max_n, deq_timeout = EVAL_BATCH_SIZE if batching else 1, 0.2
+            adm = getattr(self.server, "admission", None)
+            if adm is not None and batching:
+                max_n, deq_timeout = adm.batch_params(max_n, deq_timeout)
             t0 = time.perf_counter()
             batch = self.server.eval_broker.dequeue_many(
                 scan_types,
-                EVAL_BATCH_SIZE if batching else 1,
-                timeout=0.2,
+                max_n,
+                timeout=deq_timeout,
                 partition=(
                     self.server.lanes.lanes_of_worker(self.id)
                     if lane_mode
@@ -338,6 +347,7 @@ class Worker:
                         "namespace": ev.namespace,
                         "type": ev.type,
                         "triggered_by": ev.triggered_by,
+                        "priority": ev.priority,
                         "worker": self.id,
                         "batch_size": len(batch),
                     },

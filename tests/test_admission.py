@@ -1,0 +1,589 @@
+"""nomad_tpu.server.admission — overload FSM, priority-tiered shedding,
+and the intake seams that enforce it.
+
+The FSM matrix runs entirely under a seeded clock (same discipline as
+the resilience breakers): raising is immediate, lowering is dwell-gated
+one level at a time, and the hysteresis band between exit and enter
+holds the level — no flapping at a threshold boundary. The seam tests
+then prove the decisions land where the design says they must: shed
+only before state commitment (HTTP 429 + Retry-After, RPC throttle
+retry), defer only after (the broker's delayed heap), liveness traffic
+exempt, and every decision conserved per tier (invariant law 10).
+"""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu.server.admission import (
+    BROWNOUT,
+    NORMAL,
+    SHED,
+    AdmissionController,
+    AdmissionRejected,
+    HistWindow,
+    Signals,
+    tier_of,
+)
+from nomad_tpu.structs.evaluation import (
+    TRIGGER_JOB_DEREGISTER,
+    TRIGGER_JOB_REGISTER,
+    TRIGGER_NODE_UPDATE,
+    TRIGGER_ROLLING_UPDATE,
+)
+from nomad_tpu.utils.metrics import Metrics
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def controller(clock=None, **overrides):
+    return AdmissionController(clock=clock or FakeClock(), **overrides)
+
+
+# -- priority tiers ----------------------------------------------------------
+
+
+class TestTiers:
+    def test_tier_of_matches_repo_priority_convention(self):
+        assert tier_of(100) == "high"
+        assert tier_of(70) == "high"
+        assert tier_of(69) == "normal"
+        assert tier_of(50) == "normal"
+        assert tier_of(40) == "normal"
+        assert tier_of(39) == "low"
+        assert tier_of(30) == "low"
+        assert tier_of(0) == "low"
+
+
+# -- FSM: raise / hold / dwell-gated step-down -------------------------------
+
+
+class TestOverloadFSM:
+    def test_starts_normal(self):
+        c = controller()
+        assert c.evaluate(Signals()) == NORMAL
+
+    def test_backlog_enter_raises_immediately(self):
+        c = controller(brownout_backlog=100, shed_backlog=400)
+        assert c.evaluate(Signals(backlog=100)) == BROWNOUT
+
+    def test_normal_to_shed_jump_is_allowed(self):
+        c = controller(brownout_backlog=100, shed_backlog=400)
+        assert c.evaluate(Signals(backlog=400)) == SHED
+
+    def test_p99_vote_needs_min_samples(self):
+        c = controller(min_p99_samples=16)
+        calm = c.evaluate(Signals(p99_ms=60_000.0, p99_count=15))
+        assert calm == NORMAL
+        assert c.evaluate(Signals(p99_ms=60_000.0, p99_count=16)) == SHED
+
+    def test_imbalance_votes_brownout_only_with_real_backlog(self):
+        c = controller(imbalance_ratio=1.5, imbalance_min_backlog=64)
+        racing = Signals(backlog=10, arrival_rate=30.0, completion_rate=10.0)
+        assert c.evaluate(racing) == NORMAL  # no backlog behind it
+        racing = Signals(backlog=64, arrival_rate=30.0, completion_rate=10.0)
+        assert c.evaluate(racing) == BROWNOUT
+
+    def test_hysteresis_band_holds_without_flapping(self):
+        clk = FakeClock()
+        c = controller(
+            clock=clk, brownout_backlog=100, shed_backlog=400,
+            exit_fraction=0.5, dwell_s=2.0,
+        )
+        assert c.evaluate(Signals(backlog=100), clk.t) == BROWNOUT
+        # oscillate between just-above-exit (50) and just-below-enter
+        # (99) for many dwell periods: the level must not move
+        for i in range(40):
+            backlog = 55 if i % 2 else 99
+            assert c.evaluate(Signals(backlog=backlog), clk.advance(0.5)) == BROWNOUT
+        assert c.snapshot()["level_changes"] == 1
+
+    def test_step_down_requires_continuous_dwell(self):
+        clk = FakeClock()
+        c = controller(
+            clock=clk, brownout_backlog=100, shed_backlog=400, dwell_s=2.0,
+        )
+        assert c.evaluate(Signals(backlog=400), clk.t) == SHED
+        # cool for 1.9s, spike above exit once: the dwell window restarts
+        assert c.evaluate(Signals(backlog=10), clk.advance(1.9)) == SHED
+        assert c.evaluate(Signals(backlog=250), clk.advance(0.05)) == SHED
+        assert c.evaluate(Signals(backlog=10), clk.advance(0.05)) == SHED
+        assert c.evaluate(Signals(backlog=10), clk.advance(1.9)) == SHED
+        # 2s of continuous calm: exactly ONE level down, and the dwell
+        # clock restarts from the next calm evaluate after the step
+        assert c.evaluate(Signals(backlog=10), clk.advance(0.2)) == BROWNOUT
+        assert c.evaluate(Signals(backlog=10), clk.advance(1.0)) == BROWNOUT
+        assert c.evaluate(Signals(backlog=10), clk.advance(1.9)) == BROWNOUT
+        assert c.evaluate(Signals(backlog=10), clk.advance(0.2)) == NORMAL
+
+    def test_force_level_pins_then_fsm_resumes(self):
+        clk = FakeClock()
+        c = controller(clock=clk, dwell_s=2.0)
+        c.force_level(SHED, duration_s=1.0, now=clk.t)
+        assert c.evaluate(Signals(), clk.advance(0.5)) == SHED
+        # window expired: calm signals start the normal dwell descent,
+        # one level per completed dwell
+        assert c.evaluate(Signals(), clk.advance(1.0)) == SHED
+        assert c.evaluate(Signals(), clk.advance(2.0)) == BROWNOUT
+        assert c.evaluate(Signals(), clk.advance(0.1)) == BROWNOUT
+        assert c.evaluate(Signals(), clk.advance(2.1)) == NORMAL
+
+    def test_force_level_rejects_unknown(self):
+        with pytest.raises(ValueError):
+            controller().force_level("panic")
+
+    def test_unknown_override_rejected(self):
+        with pytest.raises(TypeError):
+            controller(not_a_knob=1)
+
+
+# -- sliding p99 window ------------------------------------------------------
+
+
+class TestHistWindow:
+    def test_window_covers_recent_samples_and_rolls(self):
+        clk = FakeClock()
+        reg = Metrics()
+        w = HistWindow(metric="m", window_s=5.0, clock=clk, registry=reg)
+        assert w.sample() == (0, 0.0)  # no series yet
+        reg.measure("m", 0.05)
+        count, p99 = w.sample()  # first read seeds the base snapshot
+        assert count == 0
+        reg.measure("m", 0.05)
+        reg.measure("m", 0.05)
+        count, p99 = w.sample()
+        assert count == 2 and p99 == pytest.approx(50.0, rel=0.2)
+        # roll one full window: prior samples stay visible (two-bucket
+        # read never drops to zero at the boundary)...
+        clk.advance(5.0)
+        count, _ = w.sample()
+        assert count == 2
+        # ...and age out after the second roll with no new samples
+        clk.advance(5.0)
+        w.sample()
+        clk.advance(5.0)
+        assert w.sample() == (0, 0.0)
+
+
+# -- intake seam (pre-commit shed) -------------------------------------------
+
+
+class TestCheckIntake:
+    def shed_controller(self):
+        clk = FakeClock()
+        c = controller(clock=clk, retry_after_s=2.0)
+        c.force_level(SHED, duration_s=3600.0, now=clk.t)
+        return c
+
+    def test_shed_matrix_per_tier(self):
+        c = self.shed_controller()
+        c.check_intake(70)  # high admits even under SHED
+        with pytest.raises(AdmissionRejected) as e:
+            c.check_intake(50)
+        assert e.value.decision == "deferred"
+        assert e.value.retry_after == pytest.approx(2.0)
+        with pytest.raises(AdmissionRejected) as e:
+            c.check_intake(30)
+        assert e.value.decision == "shed"
+        assert e.value.retry_after == pytest.approx(4.0)  # 2x backoff hint
+        assert c.counters()["high"]["admitted"] == 1
+        assert c.counters()["normal"]["deferred"] == 1
+        assert c.counters()["low"]["shed"] == 1
+        assert c.conserved()
+
+    def test_liveness_traffic_exempt_under_shed(self):
+        c = self.shed_controller()
+        c.check_intake(30, triggered_by=TRIGGER_NODE_UPDATE)
+        c.check_intake(30, triggered_by=TRIGGER_JOB_DEREGISTER)
+        snap = c.snapshot()
+        assert snap["exempt_total"] == 2
+        assert snap["counters"]["low"]["admitted"] == 2
+        assert snap["counters"]["low"]["shed"] == 0
+        assert c.conserved()
+
+    def test_normal_level_admits_everything(self):
+        c = controller()
+        for prio in (30, 50, 70):
+            c.check_intake(prio)
+        counts = c.counters()
+        assert all(counts[t]["admitted"] == 1 for t in counts)
+        assert c.conserved()
+
+
+# -- broker seam (post-commit defer) -----------------------------------------
+
+
+def _ev(priority=50, triggered_by=TRIGGER_JOB_REGISTER, type="service"):
+    return types.SimpleNamespace(
+        priority=priority, triggered_by=triggered_by, type=type
+    )
+
+
+class TestGateEnqueue:
+    def brownout_controller(self, **over):
+        clk = FakeClock()
+        over.setdefault("shed_backlog", 100)
+        c = controller(clock=clk, **over)
+        c.force_level(BROWNOUT, duration_s=3600.0, now=clk.t)
+        return c
+
+    def test_per_tier_watermark_ordering(self):
+        # watermarks at shed_backlog=100: low 25, normal 50, high 100.
+        # A ready depth between low and normal defers ONLY the low tier.
+        c = self.brownout_controller(defer_delay_s=1.0)
+        assert c.gate_enqueue(_ev(priority=30), ready_depth=30) == 1.0
+        assert c.gate_enqueue(_ev(priority=50), ready_depth=30) is None
+        assert c.gate_enqueue(_ev(priority=70), ready_depth=30) is None
+        # past the normal watermark the normal tier defers too; high
+        # only past the shed point itself
+        assert c.gate_enqueue(_ev(priority=50), ready_depth=60) == 1.0
+        assert c.gate_enqueue(_ev(priority=70), ready_depth=60) is None
+        assert c.gate_enqueue(_ev(priority=70), ready_depth=150) == 1.0
+        counts = c.counters()
+        assert counts["low"]["deferred"] == 1
+        assert counts["normal"] == {
+            "submitted": 2, "admitted": 1, "deferred": 1, "shed": 0,
+        }
+        assert counts["high"] == {
+            "submitted": 3, "admitted": 2, "deferred": 1, "shed": 0,
+        }
+        assert c.conserved()
+
+    def test_normal_level_never_defers(self):
+        c = controller(shed_backlog=100)
+        assert c.gate_enqueue(_ev(priority=30), ready_depth=99) is None
+        assert c.counters()["low"]["admitted"] == 1
+
+    def test_exempt_and_internal_traffic_pass(self):
+        c = self.brownout_controller()
+        # liveness: exempt-counted, never deferred even over watermark
+        assert c.gate_enqueue(
+            _ev(priority=30, triggered_by=TRIGGER_NODE_UPDATE),
+            ready_depth=500,
+        ) is None
+        assert c.gate_enqueue(
+            _ev(priority=30, type="_core"), ready_depth=500
+        ) is None
+        # internal followup work: admitted at intake already, passes
+        # through uncounted
+        assert c.gate_enqueue(
+            _ev(priority=30, triggered_by=TRIGGER_ROLLING_UPDATE),
+            ready_depth=500,
+        ) is None
+        snap = c.snapshot()
+        assert snap["exempt_total"] == 2
+        assert snap["counters"]["low"]["submitted"] == 2
+        assert c.conserved()
+
+    def test_batch_params_widen_in_brownout(self):
+        c = self.brownout_controller(
+            brownout_batch_factor=2, brownout_batch_timeout_s=0.4
+        )
+        assert c.batch_params(8, 0.2) == (16, 0.4)
+        calm = controller()
+        assert calm.batch_params(8, 0.2) == (8, 0.2)
+
+
+# -- RPC seam: Retry-After honored by the client -----------------------------
+
+
+class TestRPCThrottle:
+    @pytest.fixture
+    def rpc(self):
+        from nomad_tpu.rpc import RPCServer
+
+        srv = RPCServer()
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def test_throttled_nonidempotent_method_retries_with_hint(self, rpc):
+        from nomad_tpu.rpc import RPCClient
+        from nomad_tpu.rpc.client import RPCThrottled
+
+        calls = {"n": 0}
+
+        def register(_args):
+            calls["n"] += 1
+            if calls["n"] == 1 or calls["n"] < 0:
+                raise AdmissionRejected(SHED, "normal", "deferred", 1.5)
+            return {"ok": True}
+
+        rpc.register("Job.register", register)
+        sleeps: list[float] = []
+        c = RPCClient(rpc.address, sleep=sleeps.append)
+        assert not c.is_idempotent("Job.register")
+        # rejected-before-execution, so even a write method retries
+        assert c.call("Job.register", {}) == {"ok": True}
+        assert calls["n"] == 2
+        # the server's Retry-After hint (>= 1.5s, jittered up to 1.25x)
+        # wins over the default sub-second backoff
+        assert len(sleeps) == 1 and 1.5 <= sleeps[0] <= 1.875
+        c.close()
+        # and it surfaces as RPCThrottled once attempts are exhausted
+        calls["n"] = -10_000
+        c2 = RPCClient(rpc.address, max_attempts=2, sleep=sleeps.append)
+        with pytest.raises(RPCThrottled) as e:
+            c2.call("Job.register", {})
+        assert e.value.retry_after == pytest.approx(1.5)
+        c2.close()
+
+
+# -- chaos flap: forced SHED window under fault injection --------------------
+
+
+class TestChaosFlap:
+    def test_admission_flap_fault_keeps_invariants(self):
+        from nomad_tpu.chaos import FaultSpec, run_chaos
+
+        run = run_chaos(
+            seed=5, steps=60,
+            schedule=[FaultSpec("admission.flap", 0, "force")],
+        )
+        assert run.ok, run.render()
+        assert ("admission.flap", 0, "force") in run.triggered
+        adm = run.report.info["admission"]
+        assert adm["level_changes"] >= 1  # the flap forced SHED
+        counts = adm["counters"]
+        for tier in counts:
+            assert (
+                counts[tier]["admitted"]
+                + counts[tier]["deferred"]
+                + counts[tier]["shed"]
+                == counts[tier]["submitted"]
+            ), tier
+
+
+
+# -- HTTP seam: 429 + Retry-After, resilience surface ------------------------
+
+
+@pytest.fixture(scope="module")
+def live():
+    from nomad_tpu import mock
+    from nomad_tpu.api.client import NomadClient
+    from nomad_tpu.api.http import HTTPAgent
+    from nomad_tpu.server import Server, ServerConfig
+
+    server = Server(ServerConfig(num_workers=1))
+    server.establish_leadership()
+    http = HTTPAgent(server, None, port=0)
+    http.start()
+    for _ in range(2):
+        server.register_node(mock.node())
+    yield server, http, NomadClient(http.address)
+    http.stop()
+    server.shutdown()
+
+
+def _job_payload(priority):
+    from nomad_tpu import mock
+    from nomad_tpu.api.codec import encode
+
+    j = mock.job()
+    j.id = f"adm-{priority}-{int(time.time() * 1e6)}"
+    j.priority = priority
+    return encode(j)
+
+
+class TestHTTPSeam:
+    def test_register_sheds_low_priority_with_retry_after(self, live):
+        from nomad_tpu.api.client import APIException
+
+        server, http, c = live
+        server.admission.force_level(SHED, duration_s=3600.0)
+        try:
+            with pytest.raises(APIException) as e:
+                c.jobs.register(_job_payload(30))
+            assert e.value.status == 429
+            # raw request to read the Retry-After header the SDK hides
+            req = urllib.request.Request(
+                f"{http.address}/v1/jobs",
+                data=json.dumps({"job": _job_payload(30)}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as he:
+                urllib.request.urlopen(req, timeout=10)
+            assert he.value.code == 429
+            retry_after = float(he.value.headers["Retry-After"])
+            assert retry_after > 0
+            body = json.loads(he.value.read())
+            assert body["admission_level"] == SHED
+            # high priority still lands while low is shed
+            out = c.jobs.register(_job_payload(80))
+            assert out["eval_id"]
+        finally:
+            server.admission.force_level(NORMAL, duration_s=0.0)
+        assert server.admission.conserved()
+
+    def test_resilience_endpoint_reports_admission(self, live):
+        server, http, c = live
+        out = c._request("GET", "/v1/agent/resilience")
+        adm = out["admission"]
+        assert adm["level"] in (NORMAL, BROWNOUT, SHED)
+        assert set(adm["counters"]) == {"high", "normal", "low"}
+        for tier, counts in adm["counters"].items():
+            assert (
+                counts["admitted"] + counts["deferred"] + counts["shed"]
+                == counts["submitted"]
+            ), tier
+        assert any(
+            k.startswith("nomad.admission.") for k in out["counters"]
+        )
+
+
+# -- law 10 via the chaos invariant checker ----------------------------------
+
+
+class TestConservationLaw:
+    def test_admission_conservation_checked_and_tamper_detected(self):
+        from nomad_tpu import mock
+        from nomad_tpu.chaos import check_cluster
+        from nomad_tpu.chaos.invariants import metrics_baseline
+        from nomad_tpu.server import Server, ServerConfig
+
+        baseline = metrics_baseline()
+        server = Server(ServerConfig(num_workers=1))
+        try:
+            server.establish_leadership()
+            for _ in range(2):
+                server.register_node(mock.node())
+            for i in range(3):
+                j = mock.job()
+                j.id = f"law10-{i}"
+                server.register_job(j)
+            assert server.wait_for_evals(timeout=15)
+            report = check_cluster(server, plane=None, baseline=baseline)
+            assert report.ok, report.render()
+            assert "admission_conservation" in report.checked
+            assert report.info["admission"]["counters"]["normal"][
+                "submitted"
+            ] >= 3
+            # a lost decision must be caught, not absorbed
+            server.admission._counters["low"]["shed"] += 1
+            tampered = check_cluster(server, plane=None, baseline=baseline)
+            assert not tampered.ok
+            assert any(
+                v.invariant == "admission_conservation"
+                for v in tampered.violations
+            )
+        finally:
+            server.shutdown()
+
+
+
+# -- tier-1 soak smoke: spike stream + extended SLO schema -------------------
+
+
+class TestOverloadSmoke:
+    @pytest.fixture(scope="class")
+    def smoke(self):
+        from nomad_tpu.obs.loadgen import run_soak
+
+        return run_soak(
+            seed=11, seconds=3.0, rate=10.0, nodes=30, batch_workers=1,
+            spike_rate=25.0, spike_start=1.0, spike_seconds=1.0,
+            priority_mix={30: 0.3, 50: 0.4, 70: 0.3},
+        )
+
+    def test_clean_and_conserved(self, smoke):
+        assert smoke.ok, smoke.render(verbose=True)
+        assert smoke.admission["conserved"]
+        assert smoke.admission["recovered"]
+
+    def test_schema_includes_high_tier_series(self, smoke):
+        from nomad_tpu.obs.slo import SLO_SCHEMA, slo_schema_of
+
+        assert slo_schema_of(smoke.slo) == SLO_SCHEMA
+        assert any(
+            p.startswith("eval_latency_high_ms.") for p in SLO_SCHEMA
+        )
+        assert smoke.slo["eval_latency_high_ms"]["count"] > 0
+
+    def test_spike_present_in_canonical_schedule(self, smoke):
+        from nomad_tpu.obs.loadgen import build_schedule
+
+        assert smoke.canonical()["schedule"] == [
+            e.row()
+            for e in build_schedule(
+                11, 3.0, 10.0, 30,
+                spike_rate=25.0, spike_start=1.0, spike_seconds=1.0,
+                priority_mix={30: 0.3, 50: 0.4, 70: 0.3},
+            )
+        ]
+
+    def test_report_carries_admission_block(self, smoke):
+        d = smoke.to_dict()
+        assert d["admission"]["level"] == NORMAL  # defaults never engage
+        assert "admission" in smoke.render()
+
+
+# -- slow: overload acceptance + seed matrix ---------------------------------
+
+
+@pytest.mark.slow
+class TestOverloadAcceptance:
+    def test_brownout_engages_and_recovers_at_2x_saturation(self):
+        from nomad_tpu.obs.loadgen import run_soak, saturation_search
+        from nomad_tpu.obs.slo import SloTargets
+
+        sat = saturation_search(
+            seed=7, nodes=50, batch_workers=2, probe_seconds=1.0
+        )
+        run = run_soak(
+            seed=7, seconds=9.0, rate=0.9 * sat, nodes=50, batch_workers=2,
+            targets=SloTargets(
+                eval_p99_ms=None, high_eval_p99_ms=5000.0,
+                placement_p99_ms=None, queue_depth_max=None,
+                max_breaker_trips=None, max_fallback_activations=None,
+                max_lane_conflicts=None,
+            ),
+            spike_rate=2.0 * sat, spike_start=3.0, spike_seconds=3.0,
+            priority_mix={30: 0.3, 50: 0.4, 70: 0.3},
+            admission_overrides={
+                "brownout_backlog": 32, "shed_backlog": 128,
+                "brownout_p99_ms": 1000.0, "shed_p99_ms": 4000.0,
+                "min_p99_samples": 8, "reeval_interval_s": 0.1,
+                "dwell_s": 1.0, "defer_delay_s": 0.5,
+            },
+        )
+        assert run.ok, run.render(verbose=True)
+        adm = run.admission
+        assert adm["level_changes"] >= 1, "controller never engaged"
+        assert adm["recovered"], "did not return to NORMAL after drain"
+        assert adm["conserved"]
+        counts = adm["counters"]
+        present = [
+            t for t in ("low", "normal", "high") if counts[t]["submitted"]
+        ]
+        for tier in counts:
+            if tier != present[0]:
+                assert counts[tier]["shed"] == 0, (
+                    f"shed leaked into {tier}: {counts}"
+                )
+        assert run.slo["verdict"]["pass"], run.slo["verdict"]
+
+    def test_twenty_seed_chaos_matrix_with_flap(self):
+        from nomad_tpu.chaos import run_chaos
+        from nomad_tpu.chaos.plane import FAULT_KINDS
+
+        assert "force" in FAULT_KINDS  # admission.flap rides the default mix
+        for seed in range(1, 21):
+            run = run_chaos(seed=seed, steps=120)
+            assert run.ok, f"seed {seed}:\n" + run.render()

@@ -21,6 +21,7 @@ import pytest
 
 from nomad_tpu.analysis import lint, race, retrace
 from nomad_tpu.analysis.rules import REGISTRY
+from nomad_tpu.analysis.rules.admissiongate import AdmissionGateDiscipline
 from nomad_tpu.analysis.rules.determinism import WallClockInScoringPath
 from nomad_tpu.analysis.rules.hostsync import HostSyncInJitKernel
 from nomad_tpu.analysis.rules.laneowner import LaneOwnerDiscipline
@@ -572,6 +573,107 @@ class TestNTA010:
         )
 
 
+# -- NTA012: external intake routes through the admission controller -------
+
+
+class TestNTA012:
+    def test_ungated_apply_eval_create_triggers(self):
+        src = (
+            "class Handler:\n"
+            "    def handle_thing(self, job):\n"
+            "        ev = build_eval(job)\n"
+            "        self.server.apply_eval_create([ev])\n"
+        )
+        fs = run(src, "nomad_tpu/api/http.py", AdmissionGateDiscipline)
+        assert rule_ids(fs) == ["NTA012"]
+        assert fs[0].symbol == "Handler.handle_thing"
+
+    def test_ungated_broker_enqueue_triggers(self):
+        src = (
+            "class Handler:\n"
+            "    def handle_thing(self, ev):\n"
+            "        self.server.eval_broker.enqueue(ev)\n"
+        )
+        fs = run(src, "nomad_tpu/api/http.py", AdmissionGateDiscipline)
+        assert rule_ids(fs) == ["NTA012"]
+
+    def test_gated_handler_is_clean(self):
+        src = (
+            "class Handler:\n"
+            "    def handle_thing(self, job):\n"
+            "        self.server.admission.check_intake(\n"
+            "            job.priority, 'job-eval')\n"
+            "        ev = build_eval(job)\n"
+            "        self.server.apply_eval_create([ev])\n"
+        )
+        assert (
+            run(src, "nomad_tpu/api/http.py", AdmissionGateDiscipline)
+            == []
+        )
+
+    def test_gate_in_other_function_does_not_cover(self):
+        src = (
+            "class Handler:\n"
+            "    def gate(self, job):\n"
+            "        self.server.admission.check_intake(job.priority, 'x')\n"
+            "    def handle_thing(self, job):\n"
+            "        self.server.apply_eval_create([build_eval(job)])\n"
+        )
+        fs = run(src, "nomad_tpu/api/http.py", AdmissionGateDiscipline)
+        assert rule_ids(fs) == ["NTA012"]
+        assert fs[0].symbol == "Handler.handle_thing"
+
+    def test_broker_internal_reference_triggers(self):
+        src = (
+            "class Blocked:\n"
+            "    def release(self, ev):\n"
+            "        self.broker._enqueue_locked(ev)\n"
+        )
+        fs = run(src, "nomad_tpu/broker/blocked.py", AdmissionGateDiscipline)
+        assert rule_ids(fs) == ["NTA012"]
+
+    def test_ready_queue_poke_triggers(self):
+        src = (
+            "def peek(broker):\n"
+            "    return broker._ready.get('default')\n"
+        )
+        fs = run(src, "nomad_tpu/broker/blocked.py", AdmissionGateDiscipline)
+        assert rule_ids(fs) == ["NTA012"]
+
+    def test_public_enqueue_from_broker_module_is_clean(self):
+        src = (
+            "class Blocked:\n"
+            "    def release(self, evals):\n"
+            "        self.broker.enqueue_all(evals)\n"
+        )
+        assert (
+            run(src, "nomad_tpu/broker/blocked.py", AdmissionGateDiscipline)
+            == []
+        )
+
+    def test_eval_broker_impl_and_other_packages_out_of_scope(self):
+        rule = AdmissionGateDiscipline()
+        assert rule.applies_to("nomad_tpu/api/http.py")
+        assert rule.applies_to("nomad_tpu/broker/blocked.py")
+        assert not rule.applies_to("nomad_tpu/broker/eval_broker.py")
+        assert not rule.applies_to("nomad_tpu/server/worker.py")
+
+    def test_api_and_broker_at_head_are_clean(self):
+        """Every live intake seam must already pair injection with the
+        gate — zero offenders to ratchet."""
+        for rel in (
+            ("nomad_tpu", "api", "http.py"),
+            ("nomad_tpu", "broker", "blocked.py"),
+            ("nomad_tpu", "broker", "plan_apply.py"),
+        ):
+            path = os.path.join(REPO_ROOT, *rel)
+            with open(path) as f:
+                src = f.read()
+            assert (
+                run(src, "/".join(rel), AdmissionGateDiscipline) == []
+            ), rel
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -641,7 +743,7 @@ class TestBaselineRatchet:
     def test_registry_covers_all_rules(self):
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
-            "NTA007", "NTA008", "NTA009", "NTA010", "NTA011",
+            "NTA007", "NTA008", "NTA009", "NTA010", "NTA011", "NTA012",
         ]
 
 
