@@ -797,6 +797,42 @@ def main():
         if not d["ok"] or not d.get("overload", {"ok": True})["ok"]:
             sys.exit(1)
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "hetero":
+        # heterogeneity A/B: binpack vs the hetero-* policies on one
+        # seeded mixed fleet (≥3 device classes). Canonical, seeded,
+        # byte-reproducible JSON; gates (exit 1) on maxmin improving the
+        # worst-class normalized throughput share, makespan reducing the
+        # modeled batch makespan, and every policy's device pass being
+        # byte-identical to its host oracle (scheduler/hetero.py).
+        fallback = _ensure_live_backend()
+        import jax
+
+        from nomad_tpu.scheduler.hetero import run_hetero_ab
+
+        n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+        n_jobs = int(sys.argv[3]) if len(sys.argv) > 3 else 12
+        count = int(sys.argv[4]) if len(sys.argv) > 4 else 25
+        d = run_hetero_ab(
+            n_nodes=n_nodes, n_jobs=n_jobs, count_per_job=count, seed=42
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "hetero maxmin worst-share gain vs binpack "
+                    f"({n_nodes} nodes, {n_jobs} jobs x {count})",
+                    "value": d["ab"]["maxmin_worst_share_delta"],
+                    "unit": "share",
+                    "vs_baseline": 0.0,
+                    "platform": jax.devices()[0].platform,
+                    "fallback": fallback,
+                    "detail": d,
+                },
+                sort_keys=True,
+            )
+        )
+        if not d["ok"]:
+            sys.exit(1)
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "grid":
         fallback = _ensure_live_backend()
         import jax

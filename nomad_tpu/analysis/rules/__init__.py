@@ -14,6 +14,7 @@ from .blocking import BlockingWithoutTimeout  # noqa: E402
 from .laneowner import LaneOwnerDiscipline  # noqa: E402
 from .accumulation import UnboundedAccumulation  # noqa: E402
 from .admissiongate import AdmissionGateDiscipline  # noqa: E402
+from .algorithmseam import AlgorithmSeamDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -28,6 +29,7 @@ REGISTRY = [
     LaneOwnerDiscipline,  # NTA010
     UnboundedAccumulation,  # NTA011
     AdmissionGateDiscipline,  # NTA012
+    AlgorithmSeamDiscipline,  # NTA013
 ]
 
 __all__ = ["REGISTRY"]

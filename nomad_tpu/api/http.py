@@ -130,6 +130,12 @@ class HTTPAgent:
                 self.handle_scheduler_config,
             ),
             (
+                # heterogeneity observability: which device classes hold
+                # which jobs' allocations (scheduler/hetero.py)
+                re.compile(r"^/v1/operator/scheduler/placements$"),
+                self.handle_hetero_placements,
+            ),
+            (
                 # raft inspection (command/operator_raft_list.go,
                 # nomad/operator_endpoint.go RaftGetConfiguration)
                 re.compile(r"^/v1/operator/raft/configuration$"),
@@ -702,6 +708,7 @@ class HTTPAgent:
                 "name": n.name,
                 "datacenter": n.datacenter,
                 "node_class": n.node_class,
+                "device_class": n.device_class,
                 "status": n.status,
                 "scheduling_eligibility": n.scheduling_eligibility,
                 "drain": n.drain is not None,
@@ -837,8 +844,11 @@ class HTTPAgent:
         cfg = self.server.store.scheduler_config()
         if method == "GET":
             self._enforce(query, "operator_read")
+            from ..scheduler import algorithms as sched_algorithms
+
             return {
                 "scheduler_algorithm": cfg.scheduler_algorithm,
+                "available_algorithms": sched_algorithms.available(),
                 "preemption_config": {
                     "system_scheduler_enabled": cfg.preemption_system_enabled,
                     "batch_scheduler_enabled": cfg.preemption_batch_enabled,
@@ -868,11 +878,49 @@ class HTTPAgent:
                     "service_scheduler_enabled", cfg.preemption_service_enabled
                 ),
             )
-            if new_cfg.scheduler_algorithm not in ("binpack", "spread"):
-                raise APIError(400, "scheduler_algorithm must be binpack|spread")
+            from ..scheduler import algorithms as sched_algorithms
+
+            if not sched_algorithms.is_registered(new_cfg.scheduler_algorithm):
+                raise APIError(
+                    400,
+                    "scheduler_algorithm must be one of: "
+                    + "|".join(sched_algorithms.available()),
+                )
             self.server.raft_apply(MsgType.SCHED_CONFIG, {"config": new_cfg})
             return {"updated": True}
         raise APIError(405, f"method {method} not allowed")
+
+    def handle_hetero_placements(self, method, body, query):
+        """GET /v1/operator/scheduler/placements — live allocation counts
+        per device class, overall and per job: the observable effect of
+        choosing a hetero-* algorithm (scheduler/hetero.py)."""
+        if method != "GET":
+            raise APIError(405, "method not allowed")
+        self._enforce(query, "operator_read")
+        store = self.server.store
+        cfg = store.scheduler_config()
+        per_class: dict[str, int] = {}
+        per_job: dict[str, dict[str, int]] = {}
+        nodes_per_class: dict[str, int] = {}
+        for node in store.nodes():
+            dc = node.device_class
+            nodes_per_class[dc] = nodes_per_class.get(dc, 0) + 1
+            for a in store.allocs_by_node(node.id):
+                if a.terminal_status():
+                    continue
+                per_class[dc] = per_class.get(dc, 0) + 1
+                jk = f"{a.namespace}/{a.job_id}"
+                jc = per_job.setdefault(jk, {})
+                jc[dc] = jc.get(dc, 0) + 1
+        return {
+            "scheduler_algorithm": cfg.scheduler_algorithm,
+            "nodes_per_class": dict(sorted(nodes_per_class.items())),
+            "allocs_per_class": dict(sorted(per_class.items())),
+            "jobs": {
+                k: dict(sorted(v.items()))
+                for k, v in sorted(per_job.items())
+            },
+        }
 
     def handle_job_versions(self, method, body, query, job_id):
         """GET /v1/job/:id/versions (job_endpoint.go GetJobVersions)."""

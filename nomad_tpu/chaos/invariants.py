@@ -47,6 +47,14 @@ true no matter which faults fired:
     ``admitted + deferred + shed == submitted`` for every priority
     tier — no decision is lost or double-counted, even through
     ``admission.flap`` forced-level windows (server/admission.py).
+``class_capacity``
+    per-device-class conservation: within every device class (including
+    the class-less ""), summed live-allocation usage never exceeds the
+    class's summed reserved-adjusted capacity on non-terminal nodes. A
+    per-node overcommit is already ``node_capacity``; this catches the
+    heterogeneity-specific failure where a policy pass (or its cache's
+    class column going stale) books work against a class that doesn't
+    hold it (scheduler/hetero.py, device/cache.py).
 """
 
 from __future__ import annotations
@@ -71,6 +79,7 @@ INVARIANTS = (
     "eval_terminal",
     "lane_isolation",
     "admission_conservation",
+    "class_capacity",
 )
 
 
@@ -163,9 +172,14 @@ def check_cluster(
     snap = store.snapshot()
     broker = server.eval_broker
 
-    # -- node_capacity -----------------------------------------------------
+    # -- node_capacity + class_capacity ------------------------------------
+    from ..structs.resources import node_comparable_capacity
+
     report.checked["node_capacity"] = True
+    report.checked["class_capacity"] = True
     n_nodes = 0
+    class_cap: dict[str, object] = {}
+    class_used: dict[str, object] = {}
     for node in snap.nodes():
         if node.terminal_status():
             continue
@@ -180,7 +194,29 @@ def check_cluster(
                 node.id,
                 f"{len(live)} live allocs overcommit {dim} (used {used})",
             )
+        dc = getattr(node, "device_class", "")
+        cap_vec = node_comparable_capacity(node).to_vector()
+        if dc in class_cap:
+            class_cap[dc] = class_cap[dc] + cap_vec
+        else:
+            class_cap[dc] = cap_vec
+        for a in live:
+            use_vec = a.comparable_resources().to_vector()
+            if dc in class_used:
+                class_used[dc] = class_used[dc] + use_vec
+            else:
+                class_used[dc] = use_vec
+    for dc, used_vec in sorted(class_used.items()):
+        cap_vec = class_cap.get(dc)
+        if cap_vec is None or (used_vec > cap_vec).any():
+            report._fail(
+                "class_capacity",
+                dc or "(class-less)",
+                f"summed live usage {used_vec} exceeds class capacity "
+                f"{cap_vec}",
+            )
     report.info["nodes"] = n_nodes
+    report.info["device_classes"] = len(class_cap)
 
     # -- plan_ledger -------------------------------------------------------
     if plane is not None:

@@ -971,6 +971,29 @@ def cmd_operator_scheduler(args) -> int:
     return 0
 
 
+def cmd_operator_placements(args) -> int:
+    """`nomad operator placements` — live per-device-class allocation
+    counts and the active algorithm (heterogeneity observability)."""
+    c = _client(args)
+    rep = c._request("GET", "/v1/operator/scheduler/placements")
+    print(f"==> scheduler algorithm: {rep['scheduler_algorithm']}")
+    print(f"{'Device Class':<16} {'Nodes':>6} {'Allocs':>7}")
+    allocs = rep.get("allocs_per_class", {})
+    for dc, n in sorted(rep.get("nodes_per_class", {}).items()):
+        label = dc or "(class-less)"
+        print(f"{label:<16} {n:>6} {allocs.get(dc, 0):>7}")
+    jobs = rep.get("jobs", {})
+    if jobs:
+        print("\nPer job:")
+        for jk, classes in jobs.items():
+            parts = ", ".join(
+                f"{dc or '(class-less)'}={cnt}"
+                for dc, cnt in classes.items()
+            )
+            print(f"  {jk}: {parts}")
+    return 0
+
+
 def cmd_namespace(args) -> int:
     c = _client(args)
     try:
@@ -1225,9 +1248,15 @@ def build_parser() -> argparse.ArgumentParser:
     op = sub.add_parser("operator", help="operator commands").add_subparsers(
         dest="sub", required=True
     )
+    from ..scheduler.algorithms import available as _algos
+
     sched = op.add_parser("scheduler")
-    sched.add_argument("--algorithm", choices=["binpack", "spread"])
+    sched.add_argument("--algorithm", choices=_algos())
     sched.set_defaults(fn=cmd_operator_scheduler)
+    placements = op.add_parser(
+        "placements", help="per-device-class allocation counts"
+    )
+    placements.set_defaults(fn=cmd_operator_placements)
     dbg = op.add_parser("debug", help="capture a support bundle")
     dbg.add_argument("--output", "-o", default="")
     dbg.set_defaults(fn=cmd_operator_debug)

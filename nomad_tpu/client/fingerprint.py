@@ -246,6 +246,38 @@ def _fp_tpu(node: Node, ctx: dict) -> None:
         )
 
 
+def normalize_device_class(kind: str) -> str:
+    """Canonicalize an accelerator kind string into a device class slug:
+    lowercase, spaces → dashes, ``TPU v5e`` → ``tpu-v5e``,
+    ``NVIDIA A100`` → ``gpu-a100``-style names pass through as typed."""
+    slug = "-".join(str(kind).strip().lower().split())
+    return slug
+
+
+def _fp_device_class(node: Node, ctx: dict) -> None:
+    """Heterogeneity fingerprint: derive ``node.device_class`` from the
+    detected accelerator (``tpu.type`` from _fp_tpu), with an explicit
+    ``NOMAD_TPU_DEVICE_CLASS`` operator override winning. Hosts with no
+    accelerator stay class-less ("") so existing clusters schedule
+    bit-identically until an operator opts a fleet in."""
+    override = os.environ.get("NOMAD_TPU_DEVICE_CLASS", "")
+    if override:
+        node.device_class = normalize_device_class(override)
+        node.attributes["device.class"] = node.device_class
+        return
+    if node.device_class:
+        # pre-configured (client config) — keep, but surface as an attr
+        node.attributes["device.class"] = node.device_class
+        return
+    kind = node.attributes.get("tpu.type", "")
+    if kind:
+        slug = normalize_device_class(kind)
+        if not slug.startswith(("tpu", "gpu")):
+            slug = f"tpu-{slug}"
+        node.device_class = slug
+        node.attributes["device.class"] = slug
+
+
 DETECTORS = (
     _fp_cpu,
     _fp_memory,
@@ -258,6 +290,7 @@ DETECTORS = (
     _fp_consul_vault,
     _fp_nomad,
     _fp_tpu,
+    _fp_device_class,  # after _fp_tpu: consumes its tpu.type attribute
 )
 
 

@@ -113,12 +113,26 @@ class DeviceStateCache:
             if row is None:
                 new_nodes.append(node)
                 continue
-            # class/dc changes invalidate representative-node memoization
+            # class/dc changes invalidate representative-node memoization.
+            # device_class folds into computed_class (structs/node.py), so
+            # an accelerator-class flip always lands here and forces the
+            # rebuild — the cache can never serve a stale class column.
             cid = ct.class_vocab.get(node.computed_class or "")
             if cid is None or cid != ct.class_ids[row]:
                 return self._rebuild_locked(snap)
             did = ct.dc_vocab.get(node.datacenter)
             if did is None or did != ct.dc_ids[row]:
+                return self._rebuild_locked(snap)
+            # belt-and-braces for hand-mutated nodes that skipped
+            # compute_class(): a raw device_class change alone still
+            # invalidates the heterogeneity column
+            dcid = ct.device_class_vocab.get(
+                getattr(node, "device_class", "")
+            )
+            dcol = ct.device_class_ids
+            if dcid is None or (
+                dcol is not None and dcid != dcol[row]
+            ):
                 return self._rebuild_locked(snap)
         if ct.num_nodes + len(new_nodes) > ct.padded_n:
             return self._rebuild_locked(snap)  # bucket overflow
@@ -136,6 +150,9 @@ class DeviceStateCache:
         dc_vocab = dict(ct.dc_vocab)
         class_vocab = dict(ct.class_vocab)
         class_rep = list(ct.class_rep)
+        device_class_ids, _ = ct.device_class_column()
+        device_class_ids = device_class_ids.copy()
+        device_class_vocab = dict(ct.device_class_vocab)
         num_nodes = ct.num_nodes
         # attribute columns referencing changed nodes go stale; drop them
         # (recomputed lazily — node attribute changes are rare next to
@@ -155,6 +172,9 @@ class DeviceStateCache:
                 class_rep.append(row)
             class_ids[row] = cid
             dc_ids[row] = dc_vocab.setdefault(node.datacenter, len(dc_vocab))
+            device_class_ids[row] = device_class_vocab.setdefault(
+                getattr(node, "device_class", ""), len(device_class_vocab)
+            )
             capacity[row] = node_comparable_capacity(node).to_vector()
             ready[row] = node.ready()
             used[row] = _node_used(snap, node.id, dims)
@@ -192,6 +212,8 @@ class DeviceStateCache:
             node_row=node_row,
             nodes=nodes,
             attr_cache=attr_cache,
+            device_class_ids=device_class_ids,
+            device_class_vocab=device_class_vocab,
             # incremental refresh never reorders existing rows (new nodes
             # append) — row-indexed overlays stay valid
             layout_gen=ct.layout_gen,

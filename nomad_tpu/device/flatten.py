@@ -70,6 +70,15 @@ class ClusterTensors:
     # per-class representative node index (for host-side class evaluation)
     class_rep: list[int]
     node_row: dict[str, int] = field(default_factory=dict)
+    # heterogeneity axis: per-node accelerator class ids. Id 0 is always
+    # the class-less "" so hand-built tensors (benchmarks, parity
+    # corpora) and pre-heterogeneity snapshots behave identically without
+    # declaring anything. None = never flattened with classes; the
+    # device_class_column accessor synthesizes the all-classless column.
+    device_class_ids: np.ndarray | None = None  # i32[N]
+    device_class_vocab: dict[str, int] = field(
+        default_factory=lambda: {"": 0}
+    )
     # row-ordered Node objects (nodes[i] ↔ row i); kept in sync by the
     # flattener / DeviceStateCache so host-side per-class constraint
     # evaluation never re-sorts the cluster
@@ -113,6 +122,17 @@ class ClusterTensors:
         self.attr_cache[attr] = (ids, vocab)
         return ids, vocab
 
+    def device_class_column(self) -> tuple[np.ndarray, dict[str, int]]:
+        """Per-node device-class ids + vocab (id 0 = class-less "")."""
+        if self.device_class_ids is None:
+            self.device_class_ids = np.zeros(self.padded_n, dtype=np.int32)
+        return self.device_class_ids, self.device_class_vocab
+
+    @property
+    def has_device_classes(self) -> bool:
+        """True when any node declares a non-empty device_class."""
+        return len(self.device_class_vocab) > 1
+
 
 def flatten_cluster(snap, nodes=None) -> ClusterTensors:
     """Build ClusterTensors from a StateSnapshot (or an explicit node list).
@@ -138,12 +158,17 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
     class_vocab: dict[str, int] = {}
     class_rep: list[int] = []
     node_row: dict[str, int] = {}
+    device_class_ids = np.zeros(pn, dtype=np.int32)
+    device_class_vocab: dict[str, int] = {"": 0}
 
     for i, node in enumerate(nodes):
         node_row[node.id] = i
         capacity[i] = node_comparable_capacity(node).to_vector()
         ready[i] = node.ready()
         dc_ids[i] = dc_vocab.setdefault(node.datacenter, len(dc_vocab))
+        device_class_ids[i] = device_class_vocab.setdefault(
+            getattr(node, "device_class", ""), len(device_class_vocab)
+        )
         if not node.computed_class:
             node.compute_class()
         cid = class_vocab.setdefault(node.computed_class, len(class_vocab))
@@ -169,6 +194,8 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
         class_rep=class_rep,
         node_row=node_row,
         nodes=list(nodes),
+        device_class_ids=device_class_ids,
+        device_class_vocab=device_class_vocab,
     )
 
 
@@ -270,10 +297,39 @@ class GroupAsk:
     # AllocMetric filter accounting (structs.go AllocMetric): populated by
     # _eligibility_for_group, surfaced on placement failures.
     filter_stats: dict = field(default_factory=dict)
+    # Heterogeneity: per-node throughput coefficient for THIS job (the
+    # job's per-device-class map gathered through the fleet's class
+    # column). None = class-less / throughput-agnostic — every kernel and
+    # policy must treat None exactly as an all-ones vector, and the base
+    # binpack/spread kernels never read it at all (bit-identity).
+    throughputs: np.ndarray | None = None  # f32[N]
+    has_throughputs: bool = False
 
     @property
     def has_spreads(self) -> bool:
         return self.blocks is not None and self.blocks.has_spreads
+
+
+def job_throughput_vector(
+    ct: ClusterTensors, job: Job
+) -> tuple[np.ndarray | None, bool]:
+    """Gather the job's per-device-class throughput coefficients into a
+    per-node f32[N] vector (default 1.0 for unmapped classes). Returns
+    (None, False) when the fleet is class-less or the job carries no
+    coefficients — the signal every downstream consumer uses to stay on
+    the pre-heterogeneity code path bit-for-bit."""
+    throughputs = getattr(job, "throughputs", None)
+    if not throughputs or not ct.has_device_classes:
+        return None, False
+    ids, vocab = ct.device_class_column()
+    per_class = np.ones(len(vocab), dtype=np.float32)
+    for name, cid in vocab.items():
+        if name:
+            per_class[cid] = np.float32(throughputs.get(name, 1.0))
+    vec = per_class[ids]
+    if bool(np.all(vec == np.float32(1.0))):
+        return None, False
+    return vec, True
 
 
 def _eligibility_for_group(
@@ -718,6 +774,7 @@ def flatten_group_ask(
     distinct = any(
         c.operand == "distinct_hosts" for c in job.constraints_for_group(tg)
     )
+    throughputs, has_tp = job_throughput_vector(ct, job)
 
     return GroupAsk(
         job_id=job.id,
@@ -734,4 +791,6 @@ def flatten_group_ask(
         blocks=blocks,
         slot_caps=slot_caps,
         filter_stats=filter_stats,
+        throughputs=throughputs,
+        has_throughputs=has_tp,
     )

@@ -133,14 +133,25 @@ def component_scores(
     has_spreads,  # bool[]
     distinct_hosts,  # bool[]
     algorithm_spread,  # bool[] scheduler algorithm: binpack vs spread fit
+    throughputs=None,  # f32[N] normalized [0, 1] class-throughput share
 ):
     """Per-node normalized score for placing one instance of ``ask``.
     Returns (final_score f32[N] with -inf infeasible, fits bool[N]).
     Used by the dense [G, N] score-matrix path (annotation, system
-    scheduler); the placement paths use the [N, J] planes instead."""
+    scheduler); the placement paths use the [N, J] planes instead.
+
+    ``throughputs`` is the heterogeneity axis: the job's per-device-class
+    coefficient gathered per node and normalized by the job's best class
+    (scheduler/hetero.py). When given it joins the component average like
+    affinity does, and zero-throughput nodes (the job cannot progress on
+    that class) become infeasible. The gate is Python-level ``None`` —
+    class-less callers trace the exact same jaxpr as before the axis
+    existed, which is what keeps binpack/spread bit-identical."""
     proposed = used + ask  # [N, D]
     fits = jnp.all(proposed <= capacity, axis=-1) & eligible
     fits &= jnp.where(distinct_hosts, job_counts == 0, True)
+    if throughputs is not None:
+        fits &= throughputs > 0.0
 
     free_frac = jnp.where(
         capacity > 0, (capacity - proposed) / jnp.maximum(capacity, 1e-9), 1.0
@@ -167,6 +178,9 @@ def component_scores(
         + jnp.where(spread_on, 1.0, 0.0)
     )
     total = fit_score + anti + resched + aff + spread_c
+    if throughputs is not None:
+        total = total + throughputs
+        n_comp = n_comp + 1.0
     final = total / n_comp
     return jnp.where(fits, final, -jnp.inf), fits
 
@@ -877,19 +891,43 @@ def score_matrix_kernel(
     has_affinities,
     distinct_hosts,
     algorithm_spread,
+    throughputs=None,  # f32[G, N] normalized class-throughput shares
 ):
     """The dense evals×nodes score matrix (no sequential state) — used for
-    dry-run annotation, the system scheduler, and benchmarks."""
+    dry-run annotation, the system scheduler, and benchmarks. The optional
+    class axis (``throughputs``) is Python-gated on None, so class-less
+    callers compile and run the pre-heterogeneity program unchanged."""
     zero_boost = jnp.zeros(capacity.shape[0], dtype=jnp.float32)
 
-    def one(a, e, jc, dt, pn, af, ha, dh):
+    if throughputs is None:
+
+        def one(a, e, jc, dt, pn, af, ha, dh):
+            final, fits = component_scores(
+                capacity, used, a, e, jc, dt, pn, af, ha,
+                zero_boost, jnp.asarray(False), dh, algorithm_spread,
+            )
+            return final, fits
+
+        return jax.vmap(one)(
+            asks,
+            eligible,
+            job_counts,
+            desired_totals,
+            penalty_nodes,
+            affinity_scores,
+            has_affinities,
+            distinct_hosts,
+        )
+
+    def one_tp(a, e, jc, dt, pn, af, ha, dh, tp):
         final, fits = component_scores(
             capacity, used, a, e, jc, dt, pn, af, ha,
             zero_boost, jnp.asarray(False), dh, algorithm_spread,
+            throughputs=tp,
         )
         return final, fits
 
-    return jax.vmap(one)(
+    return jax.vmap(one_tp)(
         asks,
         eligible,
         job_counts,
@@ -898,6 +936,7 @@ def score_matrix_kernel(
         affinity_scores,
         has_affinities,
         distinct_hosts,
+        throughputs,
     )
 
 

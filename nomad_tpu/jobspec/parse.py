@@ -453,6 +453,35 @@ def _parse_group(block: hcl.Block, ctx: hcl.EvalContext, job: Job) -> TaskGroup:
 # -- job ------------------------------------------------------------------------
 
 
+def _parse_throughputs(b: hcl.Body, ctx: hcl.EvalContext, job_id: str) -> dict:
+    """``throughput {}`` block or ``throughput = {...}`` attribute:
+    device_class → relative rate coefficient. Rejected with a structured
+    JobspecError (one line per offending coefficient) instead of letting
+    NaN/negative/garbage values propagate into the scoring kernels."""
+    from ..structs.job import validate_throughputs
+
+    raw: dict[str, Any] = {}
+    for tb in b.blocks_of("throughput"):
+        raw.update(_attrs(tb.body, ctx))
+    if "throughput" in b.attrs:
+        val = b.attrs["throughput"].expr(ctx)
+        if not isinstance(val, dict):
+            raise JobspecError(
+                f"job {job_id!r}: throughput must be a mapping of "
+                f"device_class -> coefficient, got {type(val).__name__}"
+            )
+        raw.update(val)
+    if not raw:
+        return {}
+    problems = validate_throughputs(raw)
+    if problems:
+        raise JobspecError(
+            f"job {job_id!r}: invalid throughput stanza:\n  "
+            + "\n  ".join(problems)
+        )
+    return {k: float(v) for k, v in raw.items()}
+
+
 def parse_job(block: hcl.Block, ctx: hcl.EvalContext) -> Job:
     if not block.labels:
         raise JobspecError("job block requires an id label")
@@ -488,6 +517,7 @@ def parse_job(block: hcl.Block, ctx: hcl.EvalContext) -> Job:
         )
     _collect_cas(b, ctx, job.constraints, job.affinities, job.spreads)
     job.meta = _meta(b, ctx)
+    job.throughputs = _parse_throughputs(b, ctx, job.id)
     # job-level update{} is the default for all groups (jobspec semantics)
     job_update: Optional[UpdateStrategy] = None
     ub = b.first("update")

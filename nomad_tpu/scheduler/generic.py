@@ -15,8 +15,9 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from ..device import PlacementKernel, flatten_group_ask
+from ..device import flatten_group_ask
 from ..device.cache import DeviceStateCache
+from .algorithms import make_kernel
 from ..obs.trace import global_tracer as tracer
 from ..structs import (
     ALLOC_DESIRED_RUN,
@@ -111,7 +112,9 @@ class GenericScheduler:
         # plan has no cross-lane handoff, so foreign nodes are out);
         # shortfalls become blocked evals, never foreign-node writes.
         self.node_filter = node_filter
-        self.kernel: Optional[PlacementKernel] = None
+        # any registered algorithm's kernel (scheduler/algorithms.py) —
+        # all satisfy the PlacementKernel.place contract
+        self.kernel = None
         self.eval: Optional[Evaluation] = None
         self.job = None
         self.plan: Optional[Plan] = None
@@ -132,7 +135,7 @@ class GenericScheduler:
         )
         cfg = self.snapshot.scheduler_config()
         self.scheduler_config = cfg
-        self.kernel = PlacementKernel(cfg.scheduler_algorithm)
+        self.kernel = make_kernel(cfg.scheduler_algorithm)
 
         success = False
         for _attempt in range(limit):
@@ -231,7 +234,7 @@ class GenericScheduler:
         self.batch = self.batch or evaluation.type == "batch"
         cfg = self.snapshot.scheduler_config()
         self.scheduler_config = cfg
-        self.kernel = PlacementKernel(cfg.scheduler_algorithm)
+        self.kernel = make_kernel(cfg.scheduler_algorithm)
         placements = self._start_attempt()
         if not placements or self.job is None:
             return None

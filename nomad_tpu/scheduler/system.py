@@ -14,7 +14,7 @@ import numpy as np
 
 from ..device import flatten_group_ask
 from ..device.cache import DeviceStateCache
-from ..device.score import score_matrix_kernel
+from .algorithms import score_group
 from ..structs import (
     ALLOC_DESIRED_RUN,
     Allocation,
@@ -120,21 +120,7 @@ class SystemScheduler:
             ga = flatten_group_ask(
                 ct, self.snapshot, self.job, tg, 1, nodes_sorted=nodes_sorted
             )
-            finals, fits = score_matrix_kernel(
-                np.asarray(ct.capacity),
-                np.asarray(ct.used),
-                ga.ask[None, :],
-                ga.eligible[None, :],
-                ga.job_counts[None, :],
-                np.array([float(max(tg.count, 1))], dtype=np.float32),
-                ga.penalty_nodes[None, :],
-                ga.affinity_scores[None, :],
-                np.array([ga.has_affinities]),
-                np.array([ga.distinct_hosts]),
-                np.asarray(False),
-            )
-            finals = np.asarray(finals)[0]
-            fits_np = np.asarray(fits)[0]
+            finals, fits_np = score_group(ct, ga, float(max(tg.count, 1)))
             eligible_rows = np.nonzero(ga.eligible[: ct.num_nodes])[0]
             ask_res = tg.combined_resources()
             comparable = ComparableResources(

@@ -304,6 +304,13 @@ class Job:
     parameterized: Optional[ParameterizedJobConfig] = None
     parent_id: str = ""
     payload: bytes = b""
+    # per-device-class throughput coefficients (Gavel-style heterogeneity):
+    # device_class → relative rate this job achieves on that class. A
+    # class absent from the map runs at the default 1.0; an empty map
+    # means the job is throughput-agnostic and hetero policies treat
+    # every class identically. Values must be finite and >= 0 (0 = the
+    # job cannot make progress on that class).
+    throughputs: dict[str, float] = field(default_factory=dict)
     meta: dict[str, str] = field(default_factory=dict)
     status: str = JOB_STATUS_PENDING
     stop: bool = False
@@ -360,9 +367,43 @@ class Job:
     def namespaced_id(self) -> tuple[str, str]:
         return (self.namespace, self.id)
 
+    def throughput_for(self, device_class: str) -> float:
+        """Relative rate this job achieves on ``device_class`` (1.0 when
+        the class is unmapped or class-less)."""
+        if not device_class:
+            return 1.0
+        return float(self.throughputs.get(device_class, 1.0))
+
 
 class JobValidationError(ValueError):
     pass
+
+
+def validate_throughputs(throughputs: dict) -> list[str]:
+    """Validate a per-device-class throughput map, returning structured
+    problem strings (empty = valid). Shared by jobspec parse and job
+    admission so NaN/negative/garbage coefficients are rejected before
+    they can reach the scoring kernels."""
+    problems: list[str] = []
+    if not isinstance(throughputs, dict):
+        return [f"throughput must be a mapping, got {type(throughputs).__name__}"]
+    for key, value in throughputs.items():
+        if not isinstance(key, str) or not key:
+            problems.append(f"throughput class name must be a non-empty string, got {key!r}")
+            continue
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            problems.append(
+                f"throughput[{key!r}] must be a number, got {type(value).__name__}"
+            )
+            continue
+        v = float(value)
+        if v != v:  # NaN
+            problems.append(f"throughput[{key!r}] is NaN")
+        elif v in (float("inf"), float("-inf")):
+            problems.append(f"throughput[{key!r}] must be finite, got {v}")
+        elif v < 0:
+            problems.append(f"throughput[{key!r}] must be >= 0, got {v}")
+    return problems
 
 
 def validate_job(job: Job) -> None:
@@ -385,6 +426,8 @@ def validate_job(job: Job) -> None:
         raise JobValidationError(f"invalid job type: {job.type!r}")
     if not job.task_groups:
         raise JobValidationError("job must have at least one task group")
+    for problem in validate_throughputs(job.throughputs):
+        raise JobValidationError(problem)
     seen_groups = set()
     for tg in job.task_groups:
         if tg.name in seen_groups:

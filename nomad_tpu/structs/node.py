@@ -39,6 +39,11 @@ class Node:
     name: str = ""
     datacenter: str = "dc1"
     node_class: str = ""
+    # accelerator class for heterogeneity-aware scheduling (Gavel-style):
+    # e.g. "tpu-v5e", "tpu-v4", "gpu-a100", "cpu". "" means class-less —
+    # the node participates in scheduling exactly as before this field
+    # existed (throughput coefficient 1.0 for every job).
+    device_class: str = ""
     attributes: dict[str, str] = field(default_factory=dict)
     meta: dict[str, str] = field(default_factory=dict)
     links: dict[str, str] = field(default_factory=dict)
@@ -95,7 +100,13 @@ class Node:
             hv = self.host_volumes[name]
             h.update(f"hv:{name}:{getattr(hv, 'read_only', False)}".encode())
         h.update(self.node_resources.to_vector().tobytes())
-        self.computed_class = "v1:" + h.hexdigest()
+        # device_class participates unconditionally: two nodes differing
+        # only in accelerator class must never share a computed class, or
+        # the per-class feasibility memo (and the device cache keyed on
+        # it) silently treats a v5e and a CPU box as interchangeable.
+        h.update(b"dev:")
+        h.update(self.device_class.encode())
+        self.computed_class = "v2:" + h.hexdigest()
 
     def lookup_attribute(self, target: str) -> Optional[str]:
         """Resolve a constraint LTarget like ``${attr.kernel.name}``,
@@ -114,6 +125,8 @@ class Node:
             return self.attributes.get("platform.region", "global")
         if t == "node.class":
             return self.node_class
+        if t == "node.device_class":
+            return self.device_class
         if t.startswith("attr."):
             return self.attributes.get(t[len("attr."):])
         if t.startswith("meta."):

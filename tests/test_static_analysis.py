@@ -22,6 +22,7 @@ import pytest
 from nomad_tpu.analysis import lint, race, retrace
 from nomad_tpu.analysis.rules import REGISTRY
 from nomad_tpu.analysis.rules.admissiongate import AdmissionGateDiscipline
+from nomad_tpu.analysis.rules.algorithmseam import AlgorithmSeamDiscipline
 from nomad_tpu.analysis.rules.determinism import WallClockInScoringPath
 from nomad_tpu.analysis.rules.hostsync import HostSyncInJitKernel
 from nomad_tpu.analysis.rules.laneowner import LaneOwnerDiscipline
@@ -674,6 +675,71 @@ class TestNTA012:
             ), rel
 
 
+# -- NTA013: scheduler algorithms dispatch through the registry ------------
+
+
+class TestNTA013:
+    BAD = (
+        "from ..device.score import PlacementKernel\n"
+        "def process(cfg, ct, asks):\n"
+        "    k = PlacementKernel(cfg.scheduler_algorithm)\n"
+        "    return k.place(ct, asks)\n"
+    )
+
+    def test_direct_placement_kernel_in_scheduler_triggers(self):
+        fs = run(self.BAD, "nomad_tpu/scheduler/custom.py",
+                 AlgorithmSeamDiscipline)
+        assert rule_ids(fs) == ["NTA013"]
+        assert fs[0].symbol == "process"
+
+    def test_direct_score_matrix_kernel_in_server_triggers(self):
+        src = (
+            "from ..device.score import score_matrix_kernel\n"
+            "def annotate(ct, ga):\n"
+            "    return score_matrix_kernel(ct.capacity, ct.used)\n"
+        )
+        fs = run(src, "nomad_tpu/server/annotate.py",
+                 AlgorithmSeamDiscipline)
+        assert rule_ids(fs) == ["NTA013"]
+
+    def test_registry_routed_dispatch_is_clean(self):
+        src = (
+            "from .algorithms import make_kernel, score_group\n"
+            "def process(cfg, ct, asks):\n"
+            "    k = make_kernel(cfg.scheduler_algorithm)\n"
+            "    return k.place(ct, asks)\n"
+        )
+        assert run(src, "nomad_tpu/scheduler/custom.py",
+                   AlgorithmSeamDiscipline) == []
+
+    def test_registry_and_hetero_modules_are_exempt(self):
+        for rel in (
+            "nomad_tpu/scheduler/algorithms.py",
+            "nomad_tpu/scheduler/hetero.py",
+        ):
+            assert run(self.BAD, rel, AlgorithmSeamDiscipline) == []
+
+    def test_device_package_is_out_of_scope(self):
+        # the kernels' own implementation/parity modules define and pin
+        # them — the rule polices dispatch sites only
+        assert run(self.BAD, "nomad_tpu/device/parity.py",
+                   AlgorithmSeamDiscipline) == []
+
+    def test_scheduler_and_server_at_head_are_clean(self):
+        """The refactor left zero direct dispatch sites to ratchet:
+        generic.py and system.py route through the registry."""
+        for rel in (
+            ("nomad_tpu", "scheduler", "generic.py"),
+            ("nomad_tpu", "scheduler", "system.py"),
+        ):
+            path = os.path.join(REPO_ROOT, *rel)
+            with open(path) as f:
+                src = f.read()
+            assert (
+                run(src, "/".join(rel), AlgorithmSeamDiscipline) == []
+            ), rel
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -744,6 +810,7 @@ class TestBaselineRatchet:
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
             "NTA007", "NTA008", "NTA009", "NTA010", "NTA011", "NTA012",
+            "NTA013",
         ]
 
 
