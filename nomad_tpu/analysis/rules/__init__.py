@@ -15,6 +15,7 @@ from .laneowner import LaneOwnerDiscipline  # noqa: E402
 from .accumulation import UnboundedAccumulation  # noqa: E402
 from .admissiongate import AdmissionGateDiscipline  # noqa: E402
 from .algorithmseam import AlgorithmSeamDiscipline  # noqa: E402
+from .scoredump import ScoreDumpDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -30,6 +31,7 @@ REGISTRY = [
     UnboundedAccumulation,  # NTA011
     AdmissionGateDiscipline,  # NTA012
     AlgorithmSeamDiscipline,  # NTA013
+    ScoreDumpDiscipline,  # NTA014
 ]
 
 __all__ = ["REGISTRY"]

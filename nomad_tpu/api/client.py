@@ -217,6 +217,11 @@ class Allocations:
     def info(self, alloc_id: str):
         return self.c.get(f"/v1/allocation/{alloc_id}")
 
+    def explain(self, alloc_id: str):
+        """Score provenance: why this alloc landed on its node
+        (`nomad-tpu alloc why`)."""
+        return self.c.get(f"/v1/allocations/{alloc_id}/explain")
+
     def fs_ls(self, alloc_id: str, fs_path: str = "/"):
         return self.c.get(
             f"/v1/client/fs/ls/{alloc_id}", **{"path": fs_path}
@@ -277,6 +282,11 @@ class Evaluations:
 
     def info(self, eval_id: str):
         return self.c.get(f"/v1/evaluation/{eval_id}")
+
+    def placement(self, eval_id: str):
+        """Per-task-group placement explanation (candidate table +
+        rejection histogram) for one eval."""
+        return self.c.get(f"/v1/evaluations/{eval_id}/placement")
 
 
 class Deployments:

@@ -12,6 +12,7 @@ from typing import Any
 from ..structs import (
     Affinity,
     Allocation,
+    AllocMetric,
     Constraint,
     Evaluation,
     Job,
@@ -22,6 +23,7 @@ from ..structs import (
     Task,
     TaskGroup,
 )
+from ..structs.alloc import NodeScoreMeta
 from ..structs.job import (
     EphemeralDisk,
     MigrateStrategy,
@@ -112,11 +114,16 @@ _NESTED_LISTS = {
     "volume_mounts": VolumeMount,
     "allocated_devices": AllocatedDeviceResource,
     "instances": NodeDeviceInstance,
+    "score_meta": NodeScoreMeta,
 }
 _NESTED_DICTS = {
     "volumes": VolumeRequest,
     "host_volumes": ClientHostVolumeConfig,
     "csi_node_plugins": CSINodeInfo,
+    # evals round-trip their structured failure metrics so blocked-eval
+    # consumers (`eval status`) keep the per-dimension exhaustion counts
+    # and rejection histograms instead of opaque dicts
+    "failed_tg_allocs": AllocMetric,
 }
 
 

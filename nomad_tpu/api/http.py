@@ -117,10 +117,26 @@ class HTTPAgent:
                 self.handle_alloc_stop,
             ),
             (
+                # score provenance: why this alloc landed where it did
+                # (obs/explain.py; `nomad-tpu alloc why`)
+                re.compile(
+                    r"^/v1/allocations?/(?P<alloc_id>[^/]+)/explain$"
+                ),
+                self.handle_alloc_explain,
+            ),
+            (
                 re.compile(r"^/v1/allocation/(?P<alloc_id>[^/]+)$"),
                 self.handle_alloc,
             ),
             (re.compile(r"^/v1/evaluations$"), self.handle_evals),
+            (
+                # per-group placement explanation for one eval (the
+                # flight recorder's explanation ring, obs/recorder.py)
+                re.compile(
+                    r"^/v1/evaluations?/(?P<eval_id>[^/]+)/placement$"
+                ),
+                self.handle_eval_placement,
+            ),
             (
                 re.compile(r"^/v1/evaluation/(?P<eval_id>[^/]+)$"),
                 self.handle_eval,
@@ -818,6 +834,115 @@ class HTTPAgent:
         self._enforce_obj_ns(query, e.namespace, "read-job")
         return encode(e)
 
+    def handle_eval_placement(self, method, body, query, eval_id):
+        """GET /v1/evaluations/:id/placement — per-task-group top-k
+        score breakdowns + feasibility-rejection histograms for one
+        eval (obs/explain.py). Served from the flight recorder's
+        explanation ring; evals that aged out of the ring fall back to
+        the structured failure metrics the eval itself carries."""
+        if method != "GET":
+            raise APIError(405, "method not allowed")
+        e = self.server.store.eval_by_id(eval_id)
+        if e is None:
+            # prefix match convenience, same as handle_alloc (CLI ids)
+            matches = [
+                x
+                for x in self.server.store.evals()
+                if x.id.startswith(eval_id)
+            ]
+            if len(matches) != 1:
+                raise APIError(404, f"eval {eval_id} not found")
+            e = matches[0]
+        self._enforce_obj_ns(query, e.namespace, "read-job")
+        from ..obs.recorder import flight_recorder
+
+        payload = flight_recorder.explanation(e.id)
+        if payload is not None:
+            return dict(payload, source="ring")
+        if e.failed_tg_allocs:
+            groups = {}
+            for tg, m in e.failed_tg_allocs.items():
+                if isinstance(m, dict):
+                    rejections = dict(m.get("rejections", {}) or {})
+                    metas = m.get("score_meta", []) or []
+                else:
+                    rejections = dict(getattr(m, "rejections", {}) or {})
+                    metas = getattr(m, "score_meta", []) or []
+                groups[tg] = {
+                    "failed": True,
+                    "rejections": rejections,
+                    "top_candidates": [
+                        {
+                            "node_id": sm["node_id"]
+                            if isinstance(sm, dict)
+                            else sm.node_id,
+                            "rank": i + 1,
+                            "final_score": sm["norm_score"]
+                            if isinstance(sm, dict)
+                            else sm.norm_score,
+                            "components": dict(
+                                sm["scores"]
+                                if isinstance(sm, dict)
+                                else sm.scores
+                            ),
+                            "placed": 0,
+                        }
+                        for i, sm in enumerate(metas)
+                    ],
+                }
+            return {
+                "eval_id": e.id,
+                "job_id": e.job_id,
+                "namespace": e.namespace,
+                "groups": groups,
+                "source": "failed_tg_allocs",
+            }
+        raise APIError(
+            404,
+            f"no placement explanation for eval {e.id} "
+            "(aged out of the ring, or placement_explanations disabled)",
+        )
+
+    def handle_alloc_explain(self, method, body, query, alloc_id):
+        """GET /v1/allocations/:id/explain — why this alloc landed on
+        its node: the alloc's own per-component score row plus (when
+        the eval is still in the explanation ring) the group-level
+        candidate table and rejection histogram."""
+        if method != "GET":
+            raise APIError(405, "method not allowed")
+        a = self.server.store.alloc_by_id(alloc_id)
+        if a is None:
+            matches = [
+                x
+                for x in self.server.store.allocs()
+                if x.id.startswith(alloc_id)
+            ]
+            if len(matches) != 1:
+                raise APIError(404, f"alloc {alloc_id} not found")
+            a = matches[0]
+        self._enforce_obj_ns(query, a.namespace, "read-job")
+        from ..obs.recorder import flight_recorder
+
+        metrics = a.metrics
+        out = {
+            "alloc_id": a.id,
+            "name": a.name,
+            "job_id": a.job_id,
+            "task_group": a.task_group,
+            "node_id": a.node_id,
+            "eval_id": a.eval_id,
+            "scores": dict(getattr(metrics, "scores", {}) or {}),
+            "score_meta": encode(getattr(metrics, "score_meta", []) or []),
+        }
+        payload = (
+            flight_recorder.explanation(a.eval_id) if a.eval_id else None
+        )
+        if payload is not None:
+            group = (payload.get("groups") or {}).get(a.task_group)
+            if group is not None:
+                out["explanation"] = group
+        return out
+
     def handle_alloc_stop(self, method, body, query, alloc_id):
         """POST /v1/allocation/:id/stop (alloc_endpoint.go Stop): mark
         the alloc for migration and evaluate its job."""
@@ -856,6 +981,9 @@ class HTTPAgent:
                 },
                 "memory_oversubscription_enabled": cfg.memory_oversubscription_enabled,
                 "pause_eval_broker": cfg.pause_eval_broker,
+                "placement_explanations": getattr(
+                    cfg, "placement_explanations", True
+                ),
             }
         if method in ("POST", "PUT"):
             self._enforce(query, "operator_write")
@@ -876,6 +1004,10 @@ class HTTPAgent:
                 ),
                 preemption_service_enabled=pc.get(
                     "service_scheduler_enabled", cfg.preemption_service_enabled
+                ),
+                placement_explanations=body.get(
+                    "placement_explanations",
+                    getattr(cfg, "placement_explanations", True),
                 ),
             )
             from ..scheduler import algorithms as sched_algorithms

@@ -160,7 +160,41 @@ def cmd_job_plan(args) -> int:
     if out.get("failed_tg_allocs"):
         print("  WARNING: some allocations would fail to place:")
         for tg, m in out["failed_tg_allocs"].items():
-            print(f"    {tg}: {m}")
+            if isinstance(m, dict):
+                detail = []
+                dims = m.get("dimension_exhausted") or {}
+                if dims:
+                    detail.append(
+                        "exhausted "
+                        + ", ".join(
+                            f"{k}={v}" for k, v in sorted(dims.items())
+                        )
+                    )
+                rej = m.get("rejections") or {}
+                if rej:
+                    detail.append(
+                        ", ".join(f"{k}={v}" for k, v in sorted(rej.items()))
+                    )
+                suffix = f" ({'; '.join(detail)})" if detail else ""
+                print(
+                    f"    {tg}: {m.get('coalesced_failures', 0)} "
+                    f"failure(s){suffix}"
+                )
+            else:
+                print(f"    {tg}: {m}")
+    if getattr(args, "verbose", False):
+        # -verbose: per-group candidate score tables from the dry run's
+        # explain seam (scheduler/annotate.py)
+        for tg, group in sorted(
+            (out.get("placement_explanations") or {}).items()
+        ):
+            print(
+                f"\nScores for group {tg!r} "
+                f"(algorithm {group.get('algorithm', '?')}, "
+                f"{group.get('feasible_nodes', 0)}/"
+                f"{group.get('nodes_evaluated', 0)} nodes feasible)"
+            )
+            _render_candidate_table(group)
     return 0
 
 
@@ -334,6 +368,137 @@ def cmd_eval_status(args) -> int:
     except APIException as e2:
         return _fail(str(e2))
     print(json.dumps(e, indent=2, default=str))
+    failed = e.get("failed_tg_allocs") or {}
+    if failed:
+        # structured failure summary: what to drain or resize
+        # (AllocMetric.dimension_exhausted / class_exhausted / rejections)
+        print("\nFailed Placements")
+        for tg, m in sorted(failed.items()):
+            if not isinstance(m, dict):
+                print(f"  group {tg!r}: placement failed")
+                continue
+            print(
+                f"  group {tg!r}: {m.get('nodes_exhausted', 0)} of "
+                f"{m.get('nodes_evaluated', 0)} nodes exhausted "
+                f"({m.get('coalesced_failures', 0)} coalesced failures)"
+            )
+            dims = m.get("dimension_exhausted") or {}
+            if dims:
+                parts = ", ".join(
+                    f"{k}={v}" for k, v in sorted(dims.items())
+                )
+                print(f"    exhausted dimensions: {parts}")
+            classes = m.get("class_exhausted") or {}
+            if classes:
+                parts = ", ".join(
+                    f"{k}={v}" for k, v in sorted(classes.items())
+                )
+                print(f"    infeasible device classes: {parts}")
+            rej = m.get("rejections") or {}
+            if rej:
+                parts = ", ".join(
+                    f"{k}={v}" for k, v in sorted(rej.items())
+                )
+                print(f"    rejections: {parts}")
+    return 0
+
+
+def _render_candidate_table(group: dict, indent: str = "  ") -> None:
+    """Render one group's explanation dict (obs/explain.py
+    explanation_to_dict shape) as the `alloc why` / `eval placement`
+    candidate table."""
+    cands = group.get("top_candidates") or []
+    if cands:
+        comp_keys = sorted(
+            {k for c in cands for k in (c.get("components") or {})}
+        )
+        print(
+            f"{indent}{'Rank':<5} {'Node':<10} {'Final':>9} {'Placed':>7}  "
+            + "  ".join(f"{k:>22}" for k in comp_keys)
+        )
+        for c in cands:
+            comps = c.get("components") or {}
+            print(
+                f"{indent}{c.get('rank', '?'):<5} "
+                f"{str(c.get('node_id', ''))[:8]:<10} "
+                f"{c.get('final_score', 0.0):>9.4f} {c.get('placed', 0):>7}  "
+                + "  ".join(
+                    f"{comps[k]:>22.4f}" if k in comps else f"{'-':>22}"
+                    for k in comp_keys
+                )
+            )
+    rej = group.get("rejections") or {}
+    if rej:
+        parts = ", ".join(f"{k}={v}" for k, v in sorted(rej.items()))
+        print(f"{indent}rejections: {parts}")
+    placed = group.get("placed_nodes") or []
+    if placed:
+        shown = ", ".join(n[:8] for n in placed[:8])
+        more = f" (+{len(placed) - 8} more)" if len(placed) > 8 else ""
+        print(f"{indent}placed on: {shown}{more}")
+
+
+def cmd_alloc_why(args) -> int:
+    """nomad-tpu alloc why <alloc>: per-component score provenance for
+    one allocation (command analog of AllocMetric/ScoreMetaData)."""
+    c = _client(args)
+    try:
+        out = c.allocations.explain(args.alloc_id)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"Allocation = {out.get('alloc_id', '')}")
+    print(f"Job        = {out.get('job_id', '')}")
+    print(f"Group      = {out.get('task_group', '')}")
+    print(f"Node       = {out.get('node_id', '')}")
+    print(f"Eval       = {out.get('eval_id', '')}")
+    for sm in out.get("score_meta") or []:
+        comps = ", ".join(
+            f"{k}={v:.4f}"
+            for k, v in sorted((sm.get("scores") or {}).items())
+        )
+        print(
+            f"\nScore ({str(sm.get('node_id', ''))[:8]}) = "
+            f"{sm.get('norm_score', 0.0):.4f}"
+            + (f"  [{comps}]" if comps else "")
+        )
+    group = out.get("explanation")
+    if group:
+        print(
+            f"\nCandidates (algorithm {group.get('algorithm', '?')}, "
+            f"{group.get('feasible_nodes', 0)}/"
+            f"{group.get('nodes_evaluated', 0)} nodes feasible)"
+        )
+        _render_candidate_table(group)
+    elif not out.get("score_meta"):
+        print(
+            "\nno explanation available (eval aged out of the ring, or "
+            "placement_explanations disabled)"
+        )
+    return 0
+
+
+def cmd_eval_placement(args) -> int:
+    """nomad-tpu eval placement <eval>: per-group candidate tables +
+    rejection histograms for one evaluation."""
+    c = _client(args)
+    try:
+        out = c.evaluations.placement(args.eval_id)
+    except APIException as e:
+        return _fail(str(e))
+    print(f"Evaluation = {out.get('eval_id', '')}")
+    print(f"Job        = {out.get('job_id', '')}")
+    if out.get("source"):
+        print(f"Source     = {out['source']}")
+    for tg, group in sorted((out.get("groups") or {}).items()):
+        algo = group.get("algorithm", "")
+        detail = (
+            f" (algorithm {algo}, {group.get('feasible_nodes', 0)}/"
+            f"{group.get('nodes_evaluated', 0)} nodes feasible)"
+            if algo
+            else ""
+        )
+        print(f"\nGroup {tg!r}{detail}")
+        _render_candidate_table(group)
     return 0
 
 
@@ -1122,6 +1287,10 @@ def build_parser() -> argparse.ArgumentParser:
     plan = job.add_parser("plan")
     plan.add_argument("file")
     plan.add_argument("-var", action="append", dest="var", metavar="key=value")
+    plan.add_argument(
+        "-verbose", action="store_true", dest="verbose",
+        help="show per-group candidate score tables",
+    )
     plan.set_defaults(fn=cmd_job_plan)
     status = job.add_parser("status")
     status.add_argument("job_id", nargs="?")
@@ -1194,6 +1363,11 @@ def build_parser() -> argparse.ArgumentParser:
     astatus = alloc.add_parser("status")
     astatus.add_argument("alloc_id")
     astatus.set_defaults(fn=cmd_alloc_status)
+    awhy = alloc.add_parser(
+        "why", help="score provenance: why the alloc landed on its node"
+    )
+    awhy.add_argument("alloc_id")
+    awhy.set_defaults(fn=cmd_alloc_why)
 
     ev = sub.add_parser("eval", help="eval commands").add_subparsers(
         dest="sub", required=True
@@ -1203,6 +1377,11 @@ def build_parser() -> argparse.ArgumentParser:
     estatus.set_defaults(fn=cmd_eval_status)
     elist = ev.add_parser("list")
     elist.set_defaults(fn=cmd_eval_list)
+    eplace = ev.add_parser(
+        "placement", help="per-group candidate tables for an eval"
+    )
+    eplace.add_argument("eval_id")
+    eplace.set_defaults(fn=cmd_eval_placement)
 
     dep = sub.add_parser("deployment", help="deployment commands").add_subparsers(
         dest="sub", required=True

@@ -1049,6 +1049,10 @@ class PlacementResult:
     overflow_scores: np.ndarray = field(
         default_factory=lambda: np.empty(0, dtype=np.float32)
     )
+    # score provenance (obs/explain.PlacementExplanation), attached only
+    # when the pass ran with explain=True; purely observational — never
+    # consulted by repair or the schedulers' placement decisions
+    explanation: Optional[object] = None
 
 
 class PlacementKernel:
@@ -1057,6 +1061,7 @@ class PlacementKernel:
     varying batch sizes hit a small set of compiled programs."""
 
     def __init__(self, algorithm: str = "binpack", force_scan: bool = False):
+        self.algorithm = algorithm
         self.algorithm_spread = algorithm == "spread"
         self.force_scan = force_scan  # parity testing: disable the fast path
 
@@ -1070,6 +1075,7 @@ class PlacementKernel:
         decorrelate_salt: int = 0,
         decorrelate_workers: int = 1,  # concurrent batching workers
         used_override=None,  # [pn, D] optimistic usage (pipelined passes)
+        explain: bool = False,  # attach score provenance (obs/explain)
     ) -> list[PlacementResult]:
         """``overflow`` = extra greedy candidates emitted per lane for
         conflict repair. ``decorrelate``: stripe each lane onto a disjoint
@@ -1149,6 +1155,23 @@ class PlacementKernel:
                         ),
                     ):
                         out[i] = r
+        if explain:
+            # Python-level gate, exactly like the hetero ``None`` gate:
+            # explain-off passes run the identical code above (no new
+            # traced program exists in either mode) and place
+            # bit-for-bit. Explanations are built host-side against the
+            # ORIGINAL asks and the pass's base usage — decorrelation
+            # stripes/jitter are a placement optimization repair undoes,
+            # not part of the score semantics being explained.
+            from ..obs.explain import explain_group
+
+            for a, res in zip(asks, out):
+                if res is not None:
+                    res.explanation = explain_group(
+                        cluster, a, used0,
+                        algorithm=self.algorithm,
+                        algorithm_spread=self.algorithm_spread,
+                    )
         return out
 
     @staticmethod

@@ -50,6 +50,13 @@ class FlightRecorder:
         # listeners see every completed trace even when the ring
         # wraps — the SLO collector windows latencies through this
         self._listeners: list[Callable[[dict], None]] = []
+        # placement-explanation ring (obs/explain.py): eval_id → payload
+        # dict, same capacity/eviction discipline as the trace ring so
+        # `alloc why` / `/v1/evaluations/:id/placement` have a bounded,
+        # always-on store; lifetime counters state coverage like traces
+        self._explanations: "OrderedDict[str, dict]" = OrderedDict()
+        self.explanations_total = 0
+        self.explanations_evicted = 0
 
     # -- writes ------------------------------------------------------------
     def add_listener(self, fn: Callable[[dict], None]) -> None:
@@ -100,6 +107,35 @@ class FlightRecorder:
             except Exception:
                 global_metrics.incr("nomad.obs.listener_errors")
 
+    def record_explanation(self, eval_id: str, payload: dict) -> None:
+        """Ring one eval's placement explanation (dict of task group →
+        explanation dict, plus eval metadata). Re-records move to the
+        tail; evictions bump ``nomad.obs.explanations_evicted`` outside
+        the lock, mirroring ``record``."""
+        evicted = 0
+        with self._lock:
+            if eval_id in self._explanations:
+                del self._explanations[eval_id]
+            self._explanations[eval_id] = payload
+            self.explanations_total += 1
+            while len(self._explanations) > self.capacity:
+                self._explanations.popitem(last=False)
+                evicted += 1
+            self.explanations_evicted += evicted
+        if evicted:
+            global_metrics.incr("nomad.obs.explanations_evicted", evicted)
+        global_metrics.incr("nomad.obs.explanations_recorded")
+
+    def explanation(self, eval_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._explanations.get(eval_id)
+
+    def explanations(self, n: int = 50) -> list[dict]:
+        """Newest-first explanation payloads (bounded index view)."""
+        with self._lock:
+            items = list(reversed(self._explanations.values()))
+        return items[: max(0, n)]
+
     def record_error(
         self, component: str, error: str, eval_id: str = ""
     ) -> None:
@@ -118,6 +154,7 @@ class FlightRecorder:
         with self._lock:
             self._traces.clear()
             self._errors.clear()
+            self._explanations.clear()
 
     # -- reads -------------------------------------------------------------
     def get(self, eval_id: str) -> Optional[dict]:

@@ -140,14 +140,24 @@ class HeteroCostAlgorithm(_HeteroAlgorithm):
 # -- registry-routed score matrix -------------------------------------------
 
 
-def score_group(ct, ga, desired_total: float, algorithm_spread: bool = False):
+def score_group(
+    ct,
+    ga,
+    desired_total: float,
+    algorithm_spread: bool = False,
+    explain: bool = False,
+):
     """Dense score row for one flattened group ask — the registry-routed
     wrapper over score_matrix_kernel for matrix consumers (system
     scheduler, annotation). Feeds the heterogeneity axis when the ask
     carries one: coefficients normalize by the job's best eligible class
     so the score term lands in [0, 1] like every other component.
 
-    Returns (finals f32[N], fits bool[N]) as numpy."""
+    Returns (finals f32[N], fits bool[N]) as numpy; with ``explain``
+    (Python-gated like the throughput ``None`` gate: the kernel call
+    below is untouched either way) the return grows a third element, an
+    ``obs.explain.PlacementExplanation`` carrying top-k candidates and
+    the feasibility-rejection histogram."""
     from ..device.score import score_matrix_kernel
 
     throughputs = None
@@ -170,4 +180,17 @@ def score_group(ct, ga, desired_total: float, algorithm_spread: bool = False):
         np.asarray(algorithm_spread),
         throughputs,
     )
-    return np.asarray(finals)[0], np.asarray(fits)[0]
+    if not explain:
+        return np.asarray(finals)[0], np.asarray(fits)[0]
+    from ..obs.explain import explain_group
+
+    ex = explain_group(
+        ct,
+        ga,
+        np.asarray(ct.used),
+        algorithm="spread" if algorithm_spread else "binpack",
+        algorithm_spread=algorithm_spread,
+        throughputs=throughputs[0] if throughputs is not None else None,
+        desired_total=float(max(desired_total, 1)),
+    )
+    return np.asarray(finals)[0], np.asarray(fits)[0], ex

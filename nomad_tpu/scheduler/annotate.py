@@ -84,12 +84,40 @@ def plan_job(store, job) -> dict:
     for e in planner.evals:
         if e.failed_tg_allocs:
             for tg, metric in e.failed_tg_allocs.items():
+                # structured failure detail straight off the AllocMetric
+                # the scheduler built — the explain seam stamped its
+                # rejection histogram and near-miss score table onto it,
+                # so the dry run reports the same counts a live eval
+                # would (no re-derivation here)
                 failed[tg] = {
                     "coalesced_failures": getattr(
                         metric, "coalesced_failures", 0
                     )
-                    + 1
+                    + 1,
+                    "nodes_evaluated": getattr(metric, "nodes_evaluated", 0),
+                    "nodes_exhausted": getattr(metric, "nodes_exhausted", 0),
+                    "dimension_exhausted": dict(
+                        getattr(metric, "dimension_exhausted", {}) or {}
+                    ),
+                    "class_exhausted": dict(
+                        getattr(metric, "class_exhausted", {}) or {}
+                    ),
+                    "rejections": dict(
+                        getattr(metric, "rejections", {}) or {}
+                    ),
                 }
+    # score provenance without commit: the scheduler kept its per-group
+    # explanations (annotate_plan suppresses the flight-recorder ring),
+    # so `job plan -verbose` can render candidate tables for a job that
+    # never ran
+    explanations = {}
+    sched_ex = getattr(sched, "explanations", None)
+    if sched_ex:
+        from ..obs.explain import explanation_to_dict
+
+        explanations = {
+            tg: explanation_to_dict(ex) for tg, ex in sched_ex.items()
+        }
     if plan is not None:
         placed = {}
         for allocs in plan.node_allocation.values():
@@ -112,4 +140,5 @@ def plan_job(store, job) -> dict:
         "diff_type": "edited" if existing is not None else "added",
         "annotations": annotations,
         "failed_tg_allocs": failed,
+        "placement_explanations": explanations,
     }

@@ -136,6 +136,7 @@ class GenericScheduler:
         cfg = self.snapshot.scheduler_config()
         self.scheduler_config = cfg
         self.kernel = make_kernel(cfg.scheduler_algorithm)
+        self._explain = bool(getattr(cfg, "placement_explanations", True))
 
         success = False
         for _attempt in range(limit):
@@ -173,9 +174,13 @@ class GenericScheduler:
             if self.overlay is not None:
                 used_override = self.overlay.begin_pass(ct)
             try:
-                with tracer.span("kernel_score", tags={"lanes": len(asks)}):
+                with tracer.span(
+                    "kernel_score",
+                    tags={"lanes": len(asks), "explain": self._explain},
+                ):
                     results = self.kernel.place(
-                        ct, asks, used_override=used_override
+                        ct, asks, used_override=used_override,
+                        explain=self._explain,
                     )
                     # the repair walk is also the single-eval safety net:
                     # it resolves cross-TG conflicts within this plan and
@@ -194,6 +199,14 @@ class GenericScheduler:
                         fail_on_contention=True,
                         used_override=used_override,
                     )
+                    if self._explain:
+                        # repair moves rows in place, so provenance is
+                        # stamped from the POST-repair (= committed) rows
+                        from ..obs.explain import finalize_explanations
+
+                        finalize_explanations(
+                            ct, asks, results, used_override=used_override
+                        )
                 if self.overlay is not None:
                     for a, res in zip(asks, results):
                         rows = res.node_rows[res.node_rows >= 0]
@@ -235,6 +248,7 @@ class GenericScheduler:
         cfg = self.snapshot.scheduler_config()
         self.scheduler_config = cfg
         self.kernel = make_kernel(cfg.scheduler_algorithm)
+        self._explain = bool(getattr(cfg, "placement_explanations", True))
         placements = self._start_attempt()
         if not placements or self.job is None:
             return None
@@ -294,6 +308,7 @@ class GenericScheduler:
         plan's stops/updates; returns the placements list."""
         ev = self.eval
         self.failed_tg_allocs = {}
+        self.explanations = {}  # tg_name → PlacementExplanation
         self.followup_evals = []
         self._preempt_rank_cache = {}  # per-attempt: ct/used change
         self.job = self.snapshot.job_by_id(ev.namespace, ev.job_id)
@@ -489,6 +504,10 @@ class GenericScheduler:
         from .device import group_device_asks
 
         for (tg_name, prs, tg, ga), res in zip(tg_order, results):
+            explanation = getattr(res, "explanation", None)
+            if explanation is not None:
+                self.explanations[tg_name] = explanation
+            instance_meta = getattr(explanation, "instance_meta", None)
             ask_res = tg.combined_resources()
             comparable = ComparableResources(
                 cpu=ask_res.cpu,
@@ -499,7 +518,9 @@ class GenericScheduler:
             # device assignment is per-ALLOC; skip the whole path for the
             # common deviceless group (profiled at 23µs × every alloc)
             tg_has_devices = bool(group_device_asks(tg))
-            for pr, row, score in zip(prs, res.node_rows, res.scores):
+            for i, (pr, row, score) in enumerate(
+                zip(prs, res.node_rows, res.scores)
+            ):
                 metric = AllocMetric(
                     nodes_evaluated=ct.num_nodes,
                     nodes_available=dict(nodes_available),
@@ -520,10 +541,23 @@ class GenericScheduler:
                     )
                     metric.class_filtered = dict(fs.get("class_filtered", {}))
                     self._record_exhaustion(metric, ct, ga)
+                    if explanation is not None:
+                        # near-miss table + structured rejection histogram
+                        # ride the failed metric into the blocked eval
+                        from ..obs.explain import candidates_as_score_meta
+
+                        metric.score_meta = candidates_as_score_meta(
+                            explanation
+                        )
+                        metric.rejections = dict(explanation.rejections)
                     self._record_failure(tg_name, metric)
                     continue
                 node_id = ct.node_ids[row]
                 metric.scores[f"{node_id}.score"] = float(score)
+                if instance_meta is not None and instance_meta[i] is not None:
+                    # this alloc's own per-component breakdown (the
+                    # reference's ScoreMetaData row for the winner)
+                    metric.score_meta = [instance_meta[i]]
                 devices, dev_ok = (
                     self._assign_devices(tg, node_id)
                     if tg_has_devices
@@ -620,6 +654,19 @@ class GenericScheduler:
                 metric.dimension_exhausted["devices"] = (
                     metric.dimension_exhausted.get("devices", 0) + n
                 )
+        if ga.has_throughputs and ga.throughputs is not None:
+            # class-infeasible accounting: eligible nodes whose device
+            # class the job cannot run on (tp == 0), bucketed by class
+            # name so `eval status` says which classes to expand
+            infeasible = ga.throughputs[: ct.num_nodes][elig] <= 0.0
+            if infeasible.any():
+                classes = ct.device_class_column()[: ct.num_nodes][elig]
+                vocab = ct.device_class_vocab
+                for cid in np.unique(classes[infeasible]):
+                    name = vocab[int(cid)] or "none"
+                    metric.class_exhausted[name] = metric.class_exhausted.get(
+                        name, 0
+                    ) + int((classes[infeasible] == cid).sum())
 
     def _preemption_enabled(self) -> bool:
         cfg = self.scheduler_config
@@ -774,6 +821,25 @@ class GenericScheduler:
             blocked.snapshot_index = getattr(self.snapshot, "index", 0)
             self.planner.create_eval(blocked)
             self.blocked = blocked
+        if self.explanations and not ev.annotate_plan:
+            # ring the per-group explanations so `alloc why` /
+            # `/v1/evaluations/:id/placement` can answer after the fact;
+            # dry-run (job plan) returns them inline and skips the ring
+            from ..obs.explain import explanation_to_dict
+            from ..obs.recorder import flight_recorder
+
+            flight_recorder.record_explanation(
+                ev.id,
+                {
+                    "eval_id": ev.id,
+                    "job_id": ev.job_id,
+                    "namespace": getattr(ev, "namespace", "default"),
+                    "groups": {
+                        tg: explanation_to_dict(ex)
+                        for tg, ex in self.explanations.items()
+                    },
+                },
+            )
         self._set_status(EVAL_STATUS_COMPLETE, "")
 
     def _set_status(self, status: str, desc: str) -> None:

@@ -351,6 +351,7 @@ class HeteroPlacementKernel:
         )
         choices = np.asarray(choices)
         choice_tp = np.asarray(choice_tp)
+        explain = bool(kwargs.get("explain", False))
         results = []
         for i, a in enumerate(asks):
             rows = choices[i, : a.count].astype(np.int32)
@@ -361,7 +362,22 @@ class HeteroPlacementKernel:
                 choice_tp[i, : a.count] / np.float32(denom),
                 np.float32(-np.inf),
             ).astype(np.float32)
-            results.append(PlacementResult(node_rows=rows, scores=scores))
+            res = PlacementResult(node_rows=rows, scores=scores)
+            if explain:
+                # same Python-level gate as the base kernel: explain-off
+                # traces and places exactly as before; explanations rank
+                # by this policy's node key so the top candidate is the
+                # node the joint greedy takes first for this lane
+                from ..obs.explain import explain_hetero_group
+
+                res.explanation = explain_hetero_group(
+                    cluster, a, batch.used,
+                    policy=self.policy,
+                    tp_row=batch.tp[i],
+                    tpmax=float(batch.tpmax[i]),
+                    cost=batch.cost,
+                )
+            results.append(res)
         return results
 
 

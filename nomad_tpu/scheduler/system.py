@@ -54,13 +54,37 @@ class SystemScheduler:
         self.job = None
         self.plan = None
         self.failed_tg_allocs: dict[str, AllocMetric] = {}
+        self.explanations: dict[str, object] = {}  # tg → PlacementExplanation
 
     def process(self, evaluation: Evaluation) -> None:
         self.eval = evaluation
         self.sysbatch = self.sysbatch or evaluation.type == "sysbatch"
+        self._explain = bool(
+            getattr(
+                self.snapshot.scheduler_config(),
+                "placement_explanations",
+                True,
+            )
+        )
         for _ in range(MAX_SYSTEM_SCHEDULE_ATTEMPTS):
             if self._process_once():
                 break
+        if self.explanations and not evaluation.annotate_plan:
+            from ..obs.explain import explanation_to_dict
+            from ..obs.recorder import flight_recorder
+
+            flight_recorder.record_explanation(
+                evaluation.id,
+                {
+                    "eval_id": evaluation.id,
+                    "job_id": evaluation.job_id,
+                    "namespace": evaluation.namespace,
+                    "groups": {
+                        tg: explanation_to_dict(ex)
+                        for tg, ex in self.explanations.items()
+                    },
+                },
+            )
         import copy
 
         updated = copy.copy(evaluation)
@@ -120,7 +144,18 @@ class SystemScheduler:
             ga = flatten_group_ask(
                 ct, self.snapshot, self.job, tg, 1, nodes_sorted=nodes_sorted
             )
-            finals, fits_np = score_group(ct, ga, float(max(tg.count, 1)))
+            scored = score_group(
+                ct, ga, float(max(tg.count, 1)), explain=self._explain
+            )
+            if self._explain:
+                finals, fits_np, ex = scored
+                self.explanations[tg.name] = ex
+                # breakdowns are derived against the usage the finals
+                # were scored with, not the post-placement overlay
+                used_at_score = np.asarray(ct.used).copy()
+            else:
+                finals, fits_np = scored
+                ex = None
             eligible_rows = np.nonzero(ga.eligible[: ct.num_nodes])[0]
             ask_res = tg.combined_resources()
             comparable = ComparableResources(
@@ -137,8 +172,7 @@ class SystemScheduler:
                 if not fits_np[row]:
                     preempted_ids = self._try_preempt_node(ct, tg, row, ga.ask)
                     if not preempted_ids:
-                        m = AllocMetric(nodes_evaluated=1)
-                        m.exhausted_node(node_id, "resources")
+                        m = self._fail_metric(node_id, "resources", ex)
                         self._record_failure(tg.name, m)
                         continue
                 if (
@@ -150,8 +184,7 @@ class SystemScheduler:
                     # preemption may free them (PreemptForDevice)
                     preempted_ids = self._try_preempt_node(ct, tg, row, ga.ask)
                     if not preempted_ids:
-                        m = AllocMetric(nodes_evaluated=1)
-                        m.exhausted_node(node_id, "devices")
+                        m = self._fail_metric(node_id, "devices", ex)
                         self._record_failure(tg.name, m)
                         continue
                 alloc_id = new_id()
@@ -175,12 +208,24 @@ class SystemScheduler:
                     rollback_plan_preemptions(
                         self.plan, node_id, preempted_ids
                     )
-                    m = AllocMetric(nodes_evaluated=1)
-                    m.exhausted_node(node_id, "devices")
+                    m = self._fail_metric(node_id, "devices", ex)
                     self._record_failure(tg.name, m)
                     continue
                 metric = AllocMetric(nodes_evaluated=1)
                 metric.scores[f"{node_id}.score"] = float(finals[row])
+                if ex is not None:
+                    from ..obs.explain import score_meta_for_row
+
+                    metric.score_meta = [
+                        score_meta_for_row(
+                            ct,
+                            ga,
+                            used_at_score,
+                            int(row),
+                            desired_total=float(max(tg.count, 1)),
+                        )
+                    ]
+                    ex.placed_nodes.append(node_id)
                 alloc = Allocation(
                     id=alloc_id,
                     namespace=self.job.namespace,
@@ -257,6 +302,17 @@ class SystemScheduler:
         from .device import assign_devices_for_plan
 
         return assign_devices_for_plan(self.snapshot, self.plan, tg, node_id)
+
+    @staticmethod
+    def _fail_metric(node_id: str, dim: str, ex) -> AllocMetric:
+        m = AllocMetric(nodes_evaluated=1)
+        m.exhausted_node(node_id, dim)
+        if ex is not None:
+            # fleet-wide rejection histogram rides the (coalesced) failed
+            # metric so `eval status` explains the whole group, not just
+            # the first failing node
+            m.rejections = dict(ex.rejections)
+        return m
 
     def _record_failure(self, tg_name: str, metric: AllocMetric) -> None:
         existing = self.failed_tg_allocs.get(tg_name)

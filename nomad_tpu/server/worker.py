@@ -536,6 +536,9 @@ class Worker:
                 metrics.incr("nomad.worker.pipeline_override_passes")
             try:
                 kernel = prepared[0][2].kernel
+                # all scheds in a batch share one scheduler config, so
+                # the first lane's explain gate speaks for the pass
+                explain = bool(getattr(prepared[0][2], "_explain", False))
                 t0 = time.perf_counter()
                 # decorrelate: each lane scores a disjoint node stripe
                 # (the vector analog of per-worker shuffle sampling,
@@ -567,6 +570,7 @@ class Worker:
                     ),
                     overflow=32,
                     used_override=used_override,
+                    explain=explain,
                 )
                 from ..device.score import repair_batch_conflicts
 
@@ -581,6 +585,14 @@ class Worker:
                     lane_groups=lane_groups,
                     used_override=used_override,
                 )
+                if explain:
+                    # post-repair: stamp the committed rows into each
+                    # lane's explanation (obs/explain.py)
+                    from ..obs.explain import finalize_explanations
+
+                    finalize_explanations(
+                        ct, all_asks, results, used_override=used_override
+                    )
                 invoke_s = time.perf_counter() - t0
                 metrics.measure("nomad.worker.invoke_scheduler", invoke_s)
                 for ev, _tok, _sched, _n in prepared:
@@ -592,6 +604,7 @@ class Worker:
                             "shared": True,
                             "evals": len(prepared),
                             "lanes": len(all_asks),
+                            "explain": explain,
                         },
                     )
             except Exception as e:

@@ -43,6 +43,10 @@ class SchedulerConfiguration:
     TPU algorithm registers under). Reference: structs.SchedulerConfiguration
     (nomad/structs/operator.go:128-220, default binpack :164-169)."""
 
+    # class-level default doubles as the fallback for configs restored
+    # from pre-explainability snapshots (pickle skips __init__)
+    placement_explanations = True
+
     def __init__(
         self,
         scheduler_algorithm: str = "binpack",
@@ -51,6 +55,7 @@ class SchedulerConfiguration:
         preemption_service_enabled: bool = False,
         memory_oversubscription_enabled: bool = False,
         pause_eval_broker: bool = False,
+        placement_explanations: bool = True,
     ):
         self.scheduler_algorithm = scheduler_algorithm
         self.preemption_system_enabled = preemption_system_enabled
@@ -58,6 +63,10 @@ class SchedulerConfiguration:
         self.preemption_service_enabled = preemption_service_enabled
         self.memory_oversubscription_enabled = memory_oversubscription_enabled
         self.pause_eval_broker = pause_eval_broker
+        # score provenance (obs/explain.py): when off, placements are
+        # bit-identical (the gate is Python-level) but no explanations
+        # are built, recorded, or served
+        self.placement_explanations = placement_explanations
 
 
 class _Tables:

@@ -56,6 +56,12 @@ class AllocMetric:
     score_meta: list[NodeScoreMeta] = field(default_factory=list)
     allocation_time_ns: int = 0
     coalesced_failures: int = 0
+    # structured feasibility-rejection histogram from the explain seam
+    # (obs/explain.py): reason key → node count, e.g. "exhausted:cpu",
+    # "class-infeasible", "penalty-excluded". Finer-grained than the
+    # reference's DimensionExhausted strings; rides blocked evals so
+    # `eval status` can say what to drain or resize.
+    rejections: dict[str, int] = field(default_factory=dict)
 
     def exhausted_node(self, node_id: str, dimension: str) -> None:
         self.nodes_exhausted += 1

@@ -1,0 +1,528 @@
+"""Placement explainability (obs/explain.py): schema pin, provenance
+parity across seeds and algorithms, observational invariance (explain-off
+bit-identity + zero added retraces), structured failure-metric
+round-trips (codec + state snapshot), the flight recorder's explanation
+ring, the HTTP/plan surfaces, and lint rule NTA014.
+
+All tests here are CPU-only and ride tier-1.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from bench import build_asks, build_cluster
+from nomad_tpu import mock
+from nomad_tpu.analysis import retrace
+from nomad_tpu.device.score import PlacementKernel, repair_batch_conflicts
+from nomad_tpu.obs.explain import (
+    EXPLAIN_SCHEMA_VERSION,
+    explanation_to_dict,
+    finalize_explanations,
+)
+from nomad_tpu.obs.recorder import FlightRecorder, flight_recorder
+from nomad_tpu.structs import AllocMetric, Evaluation
+from nomad_tpu.structs.alloc import NodeScoreMeta
+from nomad_tpu.utils.metrics import global_metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    flight_recorder.clear()
+    yield
+    flight_recorder.clear()
+
+
+def _place_explained(ct, asks, algorithm="binpack"):
+    kernel = PlacementKernel(algorithm)
+    results = kernel.place(ct, asks, explain=True)
+    repair_batch_conflicts(
+        ct, asks, results, algorithm_spread=kernel.algorithm_spread
+    )
+    finalize_explanations(ct, asks, results)
+    return results
+
+
+# -- schema pin (the ~4s tier-1 smoke) --------------------------------------
+
+
+class TestExplanationSchema:
+    def test_schema_shape_is_pinned(self):
+        """The explanation dict IS the API/CLI contract — key set and
+        candidate shape must not drift without a schema_version bump."""
+        ct = build_cluster(200)
+        asks = build_asks(ct, 2, 10)
+        results = _place_explained(ct, asks)
+        d = explanation_to_dict(results[0].explanation)
+        assert set(d.keys()) == {
+            "schema_version",
+            "job_id",
+            "tg_name",
+            "algorithm",
+            "policy",
+            "nodes_evaluated",
+            "feasible_nodes",
+            "top_candidates",
+            "rejections",
+            "placed_nodes",
+        }
+        assert d["schema_version"] == EXPLAIN_SCHEMA_VERSION == 1
+        assert d["algorithm"] == "binpack"
+        assert d["nodes_evaluated"] == 200
+        assert 0 < d["feasible_nodes"] <= 200
+        assert d["top_candidates"], "feasible fleet must yield candidates"
+        for i, c in enumerate(d["top_candidates"][:5]):
+            assert set(c.keys()) == {
+                "node_id",
+                "rank",
+                "final_score",
+                "components",
+                "placed",
+            }
+            assert c["rank"] == i + 1
+            assert "binpack" in c["components"]
+        assert len(d["placed_nodes"]) == 10
+        # the dict is JSON-clean as-is (no numpy scalars)
+        json.dumps(d)
+
+    def test_candidates_rank_by_descending_score(self):
+        ct = build_cluster(200)
+        asks = build_asks(ct, 1, 5)
+        d = explanation_to_dict(_place_explained(ct, asks)[0].explanation)
+        finals = [c["final_score"] for c in d["top_candidates"]]
+        assert finals == sorted(finals, reverse=True)
+
+    def test_infeasible_fleet_yields_rejections_only(self):
+        ct = build_cluster(64)
+        asks = build_asks(ct, 1, 4)
+        a = asks[0]
+        a.ask = a.ask + np.float32(1e9)  # nothing fits
+        results = _place_explained(ct, [a])
+        ex = results[0].explanation
+        assert ex.feasible_nodes == 0
+        assert not ex.top_candidates
+        assert ex.rejections.get("exhausted:cpu", 0) > 0
+        assert ex.rejections.get("exhausted:memory_mb", 0) > 0
+
+
+# -- provenance parity ------------------------------------------------------
+
+
+class TestProvenanceParity:
+    @pytest.mark.parametrize("algorithm", ["binpack", "spread"])
+    def test_top1_matches_committed_placement_across_seeds(self, algorithm):
+        """On an uncontended (single-lane) pass over a seeded 1k-node
+        fleet, the explanation's top-1 candidate is exactly the node the
+        greedy placement committed first."""
+        for seed in (0, 1, 2):
+            ct = build_cluster(1_000, seed=42 + seed)
+            asks = build_asks(ct, 1, 50, seed=7 + seed)
+            results = _place_explained(ct, asks, algorithm=algorithm)
+            ex = results[0].explanation
+            assert ex.placed_nodes, f"seed {seed}: nothing placed"
+            assert ex.top_candidates[0].node_id == ex.placed_nodes[0], (
+                f"{algorithm} seed {seed}: top-1 "
+                f"{ex.top_candidates[0].node_id} != committed "
+                f"{ex.placed_nodes[0]}"
+            )
+            assert ex.top_candidates[0].placed >= 1
+
+    @pytest.mark.parametrize("policy", ["maxmin", "makespan", "cost"])
+    def test_hetero_top1_matches_committed_placement(self, policy):
+        from nomad_tpu.scheduler.hetero import (
+            HeteroPlacementKernel,
+            build_mixed_asks,
+            build_mixed_fleet,
+        )
+
+        for seed in (42, 43):
+            ct = build_mixed_fleet(1_000, seed=seed)
+            asks = build_mixed_asks(ct, 4, 10, seed=7)
+            kernel = HeteroPlacementKernel(policy)
+            for a in asks:  # uncontended: one lane at a time
+                results = kernel.place(ct, [a], explain=True)
+                repair_batch_conflicts(
+                    ct, [a], results, algorithm_spread=False
+                )
+                finalize_explanations(ct, [a], results)
+                ex = results[0].explanation
+                if ex is None or not ex.placed_nodes:
+                    continue
+                assert ex.algorithm == f"hetero-{policy}"
+                assert ex.policy == policy
+                assert (
+                    ex.top_candidates[0].node_id == ex.placed_nodes[0]
+                ), f"{policy} seed {seed} job {a.job_id}"
+
+    def test_instance_meta_aligns_with_committed_rows(self):
+        ct = build_cluster(500)
+        asks = build_asks(ct, 2, 20)
+        results = _place_explained(ct, asks)
+        for res in results:
+            ex = res.explanation
+            metas = ex.instance_meta
+            assert len(metas) == len(res.node_rows)
+            for row, meta in zip(np.asarray(res.node_rows), metas):
+                if row < 0:
+                    assert meta is None
+                else:
+                    assert meta.node_id == ct.node_ids[int(row)]
+                    assert "binpack" in meta.scores
+
+
+# -- observational invariance ----------------------------------------------
+
+
+class TestObservationalInvariance:
+    def test_explain_off_is_bit_identical_with_zero_added_retraces(self):
+        """Explain is host-side reconstruction: no new jitted program
+        exists in either mode, so explain-on traces the identical jaxpr
+        set and places bit-for-bit like explain-off."""
+        ct = build_cluster(500)
+        asks = build_asks(ct, 4, 25)
+        kernel = PlacementKernel("binpack")
+        kernel.place(ct, asks)  # warm the shape bucket
+        base = dict(retrace.counts())
+        off = kernel.place(ct, asks)
+        assert dict(retrace.counts()) == base
+        on = kernel.place(ct, asks, explain=True)
+        assert dict(retrace.counts()) == base, (
+            "explain=True must not add a single retrace"
+        )
+        for a, b in zip(off, on):
+            assert np.array_equal(a.node_rows, b.node_rows)
+            assert np.array_equal(a.scores, b.scores)
+        assert all(r.explanation is None for r in off)
+        assert all(r.explanation is not None for r in on)
+
+
+# -- structured failure metrics (satellite: codec + snapshot) ---------------
+
+
+def _failed_metric():
+    return AllocMetric(
+        nodes_evaluated=100,
+        nodes_exhausted=60,
+        dimension_exhausted={"cpu": 40, "memory_mb": 20},
+        class_exhausted={"tpu-v5e": 8},
+        rejections={"exhausted:cpu": 40, "class-infeasible": 8},
+        score_meta=[
+            NodeScoreMeta(
+                node_id="node-7",
+                scores={"binpack": 0.81, "job-anti-affinity": -0.1},
+                norm_score=0.355,
+            )
+        ],
+        coalesced_failures=3,
+    )
+
+
+class TestStructuredFailureMetrics:
+    def test_codec_round_trips_alloc_metric(self):
+        from nomad_tpu.api.codec import decode_eval, encode
+
+        ev = Evaluation(job_id="web", type="service")
+        ev.failed_tg_allocs = {"web": _failed_metric()}
+        wire = json.loads(json.dumps(encode(ev)))
+        back = decode_eval(wire)
+        m = back.failed_tg_allocs["web"]
+        assert isinstance(m, AllocMetric)
+        assert m.dimension_exhausted == {"cpu": 40, "memory_mb": 20}
+        assert m.class_exhausted == {"tpu-v5e": 8}
+        assert m.rejections == {"exhausted:cpu": 40, "class-infeasible": 8}
+        assert isinstance(m.score_meta[0], NodeScoreMeta)
+        assert m.score_meta[0].node_id == "node-7"
+        assert m.score_meta[0].norm_score == pytest.approx(0.355)
+
+    def test_state_snapshot_round_trips_failed_metrics(self, tmp_path):
+        from nomad_tpu.state import StateStore
+        from nomad_tpu.state.snapshot import (
+            restore_snapshot,
+            save_snapshot,
+        )
+
+        store = StateStore()
+        ev = Evaluation(job_id="web", type="service")
+        ev.failed_tg_allocs = {"web": _failed_metric()}
+        store.upsert_evals(5, [ev])
+        path = str(tmp_path / "state.snap")
+        save_snapshot(store, path)
+        restored = restore_snapshot(path)
+        m = restored.eval_by_id(ev.id).failed_tg_allocs["web"]
+        assert isinstance(m, AllocMetric)
+        assert m.rejections == {"exhausted:cpu": 40, "class-infeasible": 8}
+        assert m.score_meta[0].scores["binpack"] == pytest.approx(0.81)
+
+    def test_blocked_eval_carries_structured_metrics(self):
+        ev = Evaluation(job_id="web", type="service")
+        metric = _failed_metric()
+        blocked = ev.create_blocked_eval({}, True, "", {"web": metric})
+        carried = blocked.failed_tg_allocs["web"]
+        assert carried.rejections["exhausted:cpu"] == 40
+        assert carried.score_meta[0].node_id == "node-7"
+
+
+# -- explanation ring -------------------------------------------------------
+
+
+class TestExplanationRing:
+    def test_ring_evicts_oldest_and_counts(self):
+        r = FlightRecorder(capacity=4)
+        for i in range(6):
+            r.record_explanation(f"ev-{i}", {"eval_id": f"ev-{i}"})
+        assert r.explanation("ev-0") is None
+        assert r.explanation("ev-1") is None
+        assert r.explanation("ev-5") == {"eval_id": "ev-5"}
+        assert r.explanations_total == 6
+        assert r.explanations_evicted == 2
+        # newest first, bounded
+        ids = [p["eval_id"] for p in r.explanations()]
+        assert ids == ["ev-5", "ev-4", "ev-3", "ev-2"]
+
+    def test_rerecord_moves_to_tail(self):
+        r = FlightRecorder(capacity=2)
+        r.record_explanation("a", {"eval_id": "a", "v": 1})
+        r.record_explanation("b", {"eval_id": "b"})
+        r.record_explanation("a", {"eval_id": "a", "v": 2})
+        r.record_explanation("c", {"eval_id": "c"})  # evicts b, not a
+        assert r.explanation("b") is None
+        assert r.explanation("a")["v"] == 2
+
+    def test_metrics_counters_bump(self):
+        before = global_metrics.snapshot()["counters"].get(
+            "nomad.obs.explanations_recorded", 0
+        )
+        r = FlightRecorder(capacity=1)
+        r.record_explanation("x", {})
+        r.record_explanation("y", {})
+        counters = global_metrics.snapshot()["counters"]
+        assert (
+            counters.get("nomad.obs.explanations_recorded", 0) == before + 2
+        )
+        assert counters.get("nomad.obs.explanations_evicted", 0) >= 1
+
+    def test_clear_drops_explanations(self):
+        r = FlightRecorder()
+        r.record_explanation("a", {"eval_id": "a"})
+        r.clear()
+        assert r.explanation("a") is None
+
+
+# -- scheduler integration --------------------------------------------------
+
+
+class TestSchedulerIntegration:
+    def test_generic_scheduler_records_ring_and_alloc_meta(self):
+        from nomad_tpu.scheduler.testing import Harness
+
+        h = Harness()
+        for _ in range(4):
+            h.store.upsert_node(h.next_index(), mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 3
+        h.store.upsert_job(h.next_index(), job)
+        ev = mock.eval_for(job)
+        h.process(ev)
+
+        payload = flight_recorder.explanation(ev.id)
+        assert payload is not None, "placed eval must land in the ring"
+        assert payload["job_id"] == job.id
+        group = payload["groups"][job.task_groups[0].name]
+        assert group["schema_version"] == 1
+        assert group["top_candidates"]
+        assert len(group["placed_nodes"]) == 3
+
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        assert allocs
+        for a in allocs:
+            assert a.metrics.score_meta, "per-alloc breakdown missing"
+            meta = a.metrics.score_meta[0]
+            assert meta.node_id == a.node_id
+            assert "binpack" in meta.scores
+
+    def test_failed_placement_carries_rejections_and_near_miss(self):
+        from nomad_tpu.scheduler.testing import Harness
+
+        h = Harness()
+        node = mock.node()
+        h.store.upsert_node(h.next_index(), node)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        # ask for more cpu than any node has: placement must fail
+        job.task_groups[0].tasks[0].resources.cpu = 10**9
+        h.store.upsert_job(h.next_index(), job)
+        ev = mock.eval_for(job)
+        h.process(ev)
+
+        updated = h.evals[-1]
+        m = updated.failed_tg_allocs[job.task_groups[0].name]
+        assert m.rejections.get("exhausted:cpu", 0) >= 1
+        # a fully infeasible fleet has no candidates — but the histogram
+        # must say which axis to resize
+        assert m.dimension_exhausted.get("cpu", 0) >= 1
+
+    def test_explain_off_config_skips_ring_and_meta(self):
+        from nomad_tpu.scheduler.testing import Harness
+        from nomad_tpu.state.store import SchedulerConfiguration
+
+        h = Harness()
+        h.store.set_scheduler_config(
+            1, SchedulerConfiguration(placement_explanations=False)
+        )
+        for _ in range(3):
+            h.store.upsert_node(h.next_index(), mock.node())
+        job = mock.job()
+        h.store.upsert_job(h.next_index(), job)
+        ev = mock.eval_for(job)
+        h.process(ev)
+        assert flight_recorder.explanation(ev.id) is None
+        for a in h.store.allocs_by_job(job.namespace, job.id):
+            assert not a.metrics.score_meta
+
+    def test_system_scheduler_records_explanations(self):
+        from nomad_tpu.scheduler.testing import Harness
+
+        h = Harness()
+        for _ in range(3):
+            h.store.upsert_node(h.next_index(), mock.node())
+        job = mock.job()
+        job.type = "system"
+        h.store.upsert_job(h.next_index(), job)
+        ev = mock.eval_for(job)
+        ev.type = "system"
+        h.process(ev)
+        payload = flight_recorder.explanation(ev.id)
+        assert payload is not None
+        group = payload["groups"][job.task_groups[0].name]
+        assert group["nodes_evaluated"] == 3
+        allocs = h.store.allocs_by_job(job.namespace, job.id)
+        assert allocs
+        for a in allocs:
+            assert a.metrics.score_meta
+            assert a.metrics.score_meta[0].node_id == a.node_id
+
+
+# -- dry run (job plan) -----------------------------------------------------
+
+
+class TestAnnotatePlan:
+    def test_plan_returns_explanations_without_ringing(self):
+        from nomad_tpu.scheduler.annotate import plan_job
+        from nomad_tpu.state import StateStore
+
+        store = StateStore()
+        for i in range(3):
+            store.upsert_node(i + 1, mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        before = flight_recorder.explanations_total
+        out = plan_job(store, job)
+        assert flight_recorder.explanations_total == before, (
+            "dry run must not pollute the explanation ring"
+        )
+        group = out["placement_explanations"][job.task_groups[0].name]
+        assert group["top_candidates"]
+        assert len(group["placed_nodes"]) == 2
+        assert out["annotations"][job.task_groups[0].name]["place"] == 2
+
+    def test_plan_failed_groups_report_structured_detail(self):
+        from nomad_tpu.scheduler.annotate import plan_job
+        from nomad_tpu.state import StateStore
+
+        store = StateStore()
+        store.upsert_node(1, mock.node())
+        job = mock.job()
+        job.task_groups[0].tasks[0].resources.cpu = 10**9
+        out = plan_job(store, job)
+        failed = out["failed_tg_allocs"][job.task_groups[0].name]
+        assert failed["dimension_exhausted"].get("cpu", 0) >= 1
+        assert failed["rejections"].get("exhausted:cpu", 0) >= 1
+
+
+# -- HTTP surface -----------------------------------------------------------
+
+
+class TestHTTPSurface:
+    def test_placement_and_explain_endpoints(self):
+        from nomad_tpu.api.client import APIException, NomadClient
+        from nomad_tpu.api.http import HTTPAgent
+        from nomad_tpu.server import Server, ServerConfig
+
+        server = Server(ServerConfig(num_workers=1))
+        server.establish_leadership()
+        http = HTTPAgent(server, None, port=0)
+        http.start()
+        try:
+            c = NomadClient(http.address)
+            for _ in range(3):
+                server.register_node(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 2
+            ev = server.register_job(job)
+            assert server.wait_for_evals(timeout=15)
+
+            placement = c.evaluations.placement(ev.id)
+            assert placement["eval_id"] == ev.id
+            assert placement["source"] == "ring"
+            group = placement["groups"][job.task_groups[0].name]
+            assert group["top_candidates"][0]["rank"] == 1
+
+            allocs = c.jobs.allocations(job.id)
+            assert allocs
+            why = c.allocations.explain(allocs[0]["id"])
+            assert why["node_id"] == allocs[0]["node_id"]
+            assert why["score_meta"], "alloc explain must carry score rows"
+            assert (
+                why["score_meta"][0]["node_id"] == allocs[0]["node_id"]
+            )
+            assert why["explanation"]["placed_nodes"]
+
+            cfg = c.operator.scheduler_config()
+            assert cfg["placement_explanations"] is True
+
+            with pytest.raises(APIException):
+                c.evaluations.placement("no-such-eval")
+            with pytest.raises(APIException):
+                c.allocations.explain("no-such-alloc")
+        finally:
+            http.stop()
+            server.shutdown()
+
+
+# -- lint rule NTA014 -------------------------------------------------------
+
+
+class TestScoreDumpRule:
+    def _findings(self, source, relpath):
+        from nomad_tpu.analysis.lint import check_source
+        from nomad_tpu.analysis.rules.scoredump import ScoreDumpDiscipline
+
+        return check_source(source, relpath, [ScoreDumpDiscipline()])
+
+    def test_flags_tolist_and_dump_sinks_in_scope(self):
+        src = (
+            "def f(res):\n"
+            "    x = res.scores.tolist()\n"
+            "    return json.dumps(res.node_rows)\n"
+        )
+        found = self._findings(src, "nomad_tpu/scheduler/foo.py")
+        assert len(found) == 2
+        assert all(f.rule == "NTA014" for f in found)
+
+    def test_out_of_scope_and_compute_uses_pass(self):
+        src = "def f(res):\n    return res.scores.tolist()\n"
+        assert not self._findings(src, "nomad_tpu/obs/explain.py")
+        compute = (
+            "def f(res):\n"
+            "    rows = res.node_rows[res.node_rows >= 0]\n"
+            "    return float(res.scores[0])\n"
+        )
+        assert not self._findings(compute, "nomad_tpu/scheduler/foo.py")
+
+    def test_repo_is_clean(self):
+        from nomad_tpu.analysis.lint import repo_root, run_lint
+        from nomad_tpu.analysis.rules.scoredump import ScoreDumpDiscipline
+
+        findings = run_lint(repo_root(), rules=[ScoreDumpDiscipline()])
+        assert findings == [], [str(f) for f in findings]
