@@ -491,9 +491,13 @@ def bench_end_to_end(
                     verify_batch.get("p95_ms", 0.0), 2
                 ),
             },
+            # mesh runs: full_uploads must stay at the initial build —
+            # steady-state node updates refresh per shard, never the
+            # whole tensor (all-zero when the mesh is off)
             "device_cache": {
                 "full_flattens": server.device_cache.full_flattens,
                 "incremental_refreshes": server.device_cache.incremental_refreshes,
+                **server.device_cache.device_counters(),
             },
             # where the eval pipeline spends its time, from the span
             # traces of the measured run (flight recorder cleared at t0)
@@ -645,6 +649,68 @@ def _pop_batch_workers_arg(argv: list) -> int:
             del argv[i]
             return max(1, n)
     return auto_batch_workers()
+
+
+def _pop_mesh_arg(argv: list):
+    """Strip ``--mesh SPEC`` / ``--mesh=SPEC`` from argv (every mode
+    accepts it) and activate the mesh by seeding ``NOMAD_TPU_MESH``
+    before the first ``get_mesh()`` resolution. Returns the spec or
+    None. SPEC follows the env grammar: ``dp,mp``, ``auto``, ``off``."""
+    spec = None
+    for i, arg in enumerate(argv):
+        if arg == "--mesh" and i + 1 < len(argv):
+            spec = argv[i + 1]
+            del argv[i:i + 2]
+            break
+        if arg.startswith("--mesh="):
+            spec = arg.split("=", 1)[1]
+            del argv[i]
+            break
+    if spec is not None:
+        from nomad_tpu.utils.backend import parse_mesh_spec, reset_mesh
+
+        parse_mesh_spec(spec)  # fail fast on junk, before any JSON line
+        os.environ["NOMAD_TPU_MESH"] = spec
+        reset_mesh()
+    return spec
+
+
+def mesh_block(n_nodes: int = 0) -> dict:
+    """Self-describing mesh provenance for every bench JSON line: shape,
+    axis names, per-shard node counts, and the measured cost of the
+    per-step hierarchical reduction (per-shard local top-k + cross-shard
+    merge) at this run's padded node bucket — so MULTICHIP_r* records
+    say what the cross-shard merge cost, not just that a mesh was on."""
+    from nomad_tpu.utils.backend import get_mesh
+
+    cfg = get_mesh()
+    out = dict(cfg.describe())
+    if not cfg.active or not n_nodes:
+        return out
+    import jax
+    import jax.numpy as jnp
+
+    from nomad_tpu.device.flatten import node_bucket
+    from nomad_tpu.device.score import _topk_nodes
+
+    pn = node_bucket(n_nodes)
+    mp = cfg.n_node_shards
+    out["padded_nodes"] = pn
+    out["nodes_per_shard"] = pn // mp if pn % mp == 0 else None
+    n_shards = mp if pn % mp == 0 else 1
+    flat = jnp.asarray(
+        np.random.default_rng(0).random(pn, dtype=np.float32)
+    )
+    merge = jax.jit(lambda x: _topk_nodes(x, 16, n_shards))
+    jax.block_until_ready(merge(flat))  # compile outside the clock
+    t0 = time.perf_counter()
+    reps = 10
+    for _ in range(reps):
+        jax.block_until_ready(merge(flat))
+    out["topk_merge_us"] = round(
+        (time.perf_counter() - t0) / reps * 1e6, 1
+    )
+    return out
 
 
 def bench_soak(argv: list, batch_workers: int) -> dict:
@@ -816,11 +882,50 @@ def _bench_soak_overload(args, batch_workers: int, mix) -> dict:
 
 def main():
     batch_workers = _pop_batch_workers_arg(sys.argv)
+    mesh_spec = _pop_mesh_arg(sys.argv)
+    if len(sys.argv) > 1 and sys.argv[1] == "kernel":
+        # kernel-only mode: the multi-chip scaling headline (ROADMAP
+        # item 1's 100k-node / 1M-pending-alloc config runs here:
+        # `bench.py kernel 100000 100 10000 --mesh 2,4`) without paying
+        # for the e2e/degraded cells of the default mode
+        fallback = _ensure_live_backend()
+        import jax
+
+        n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+        n_jobs = int(sys.argv[3]) if len(sys.argv) > 3 else 100
+        count = int(sys.argv[4]) if len(sys.argv) > 4 else 1_000
+        k = bench_kernel(n_nodes, n_jobs, count)
+        per_chip_target = 100_000 / 8.0
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"allocs planned/sec ({n_jobs} jobs x {count} "
+                        f"allocs vs {n_nodes} nodes, binpack, "
+                        f"mesh={mesh_spec or 'off'})"
+                    ),
+                    "value": k["allocs_per_sec"],
+                    "unit": "allocs/s",
+                    "vs_baseline": round(
+                        k["allocs_per_sec"] / per_chip_target, 3
+                    ),
+                    "platform": jax.devices()[0].platform,
+                    "fallback": fallback,
+                    "detail": {
+                        "kernel": k,
+                        "mesh": mesh_block(n_nodes),
+                        "probe_diag": _fallback_diag(),
+                    },
+                }
+            )
+        )
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "soak":
         fallback = _ensure_live_backend()
         import jax
 
         d = bench_soak(sys.argv[2:], batch_workers)
+        d["mesh"] = mesh_block(d["nodes"])
         ev = d["slo"]["eval_latency_ms"]
         print(
             json.dumps(
@@ -858,6 +963,7 @@ def main():
         d = run_hetero_ab(
             n_nodes=n_nodes, n_jobs=n_jobs, count_per_job=count, seed=42
         )
+        d["mesh"] = mesh_block(n_nodes)
         print(
             json.dumps(
                 {
@@ -887,6 +993,7 @@ def main():
         n_lanes = int(sys.argv[3]) if len(sys.argv) > 3 else 16
         count = int(sys.argv[4]) if len(sys.argv) > 4 else 250
         d = bench_explain(n_nodes=n_nodes, n_lanes=n_lanes, count=count)
+        d["mesh"] = mesh_block(n_nodes)
         print(
             json.dumps(
                 {
@@ -910,6 +1017,7 @@ def main():
         import jax
 
         grid = bench_grid()
+        grid["mesh"] = mesh_block(10_000)  # largest grid cell's bucket
         best = max(c["allocs_per_sec"] for c in grid["cells"])
         print(
             json.dumps(
@@ -949,7 +1057,7 @@ def main():
                     else 1.0,
                     "platform": jax.devices()[0].platform,
                     "fallback": fallback,
-                    "detail": suite,
+                    "detail": {"mesh": mesh_block(), **suite},
                 }
             )
         )
@@ -962,6 +1070,7 @@ def main():
         import jax
 
         r = bench_replay(path)
+        r["mesh"] = mesh_block()
         print(
             json.dumps(
                 {
@@ -1015,6 +1124,7 @@ def main():
                 "platform": platform,
                 "fallback": fallback,
                 "detail": {
+                    "mesh": mesh_block(n_nodes),
                     "kernel": kernel,
                     "end_to_end": e2e,
                     # lane-partitioned multi-worker scaling: workers,

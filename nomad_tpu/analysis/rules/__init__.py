@@ -16,6 +16,7 @@ from .accumulation import UnboundedAccumulation  # noqa: E402
 from .admissiongate import AdmissionGateDiscipline  # noqa: E402
 from .algorithmseam import AlgorithmSeamDiscipline  # noqa: E402
 from .scoredump import ScoreDumpDiscipline  # noqa: E402
+from .shardingseam import ShardingSeamDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -32,6 +33,7 @@ REGISTRY = [
     AdmissionGateDiscipline,  # NTA012
     AlgorithmSeamDiscipline,  # NTA013
     ScoreDumpDiscipline,  # NTA014
+    ShardingSeamDiscipline,  # NTA015
 ]
 
 __all__ = ["REGISTRY"]

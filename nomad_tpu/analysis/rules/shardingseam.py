@@ -1,0 +1,64 @@
+"""NTA015 — device placement goes through the mesh sharding seam.
+
+``utils/backend.py`` owns mesh discovery (``get_mesh``) and array
+placement (``shard_put``): it is the ONE site that maps logical axes
+("groups", "nodes") to ``NamedSharding`` specs and knows the degenerate
+single-device case. A device or scheduler module that calls
+``jax.device_put`` directly, or constructs ``NamedSharding`` /
+``PartitionSpec`` itself, either pins a tensor to one device (silently
+replicating the node axis — the exact full-gather the region-major
+layout exists to avoid) or forks the axis-name/divisibility logic so
+the two copies drift. Under a 100k-node mesh that is not a style nit:
+one bare ``device_put`` of a ``[G, N]`` tensor re-materializes the
+whole node axis on every chip per step.
+
+Flagged: any call whose dotted leaf is ``device_put``,
+``NamedSharding``, or ``PartitionSpec`` inside ``nomad_tpu/device/``
+or ``nomad_tpu/scheduler/``.
+
+Exempt: ``device/cache.py`` — its per-shard incremental refresh IS the
+seam's partial-upload half: it must ``device_put`` one shard's slice to
+one specific device (``shard_put`` only expresses whole-tensor
+layouts). ``utils/backend.py`` itself is out of scope by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, Rule, ScopedVisitor, dotted_name
+
+_SCOPES = ("nomad_tpu/device/", "nomad_tpu/scheduler/")
+_EXEMPT = ("nomad_tpu/device/cache.py",)
+
+_PLACEMENT_LEAVES = ("device_put", "NamedSharding", "PartitionSpec")
+
+
+class _PlacementVisitor(ScopedVisitor):
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _PLACEMENT_LEAVES:
+            self.add(
+                "NTA015",
+                node,
+                f"bare device placement {leaf}(...): route through "
+                "utils/backend.py shard_put so node-axis tensors follow "
+                "the mesh layout instead of replicating onto every chip",
+            )
+        self.generic_visit(node)
+
+
+class ShardingSeamDiscipline(Rule):
+    id = "NTA015"
+    title = "device placement goes through the mesh sharding seam"
+
+    def applies_to(self, relpath: str) -> bool:
+        if relpath in _EXEMPT:
+            return False
+        return relpath.startswith(_SCOPES)
+
+    def check(self, tree, source, relpath) -> list[Finding]:
+        v = _PlacementVisitor(relpath)
+        v.visit(tree)
+        return v.findings

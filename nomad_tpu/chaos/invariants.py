@@ -55,6 +55,13 @@ true no matter which faults fired:
     heterogeneity-specific failure where a policy pass (or its cache's
     class column going stale) books work against a class that doesn't
     hold it (scheduler/hetero.py, device/cache.py).
+``shard_consistency``
+    with a multi-chip mesh active, the DeviceStateCache's sharded
+    device-resident capacity, re-gathered to host per shard, equals the
+    store-derived reference tensors *exactly* (bitwise) — per-shard
+    incremental refresh (dirty-region tracking) and the
+    ``mesh.shard_refresh_drop`` chaos recovery path never leave a stale
+    slice on any device (device/cache.py, utils/backend.py).
 """
 
 from __future__ import annotations
@@ -80,6 +87,7 @@ INVARIANTS = (
     "lane_isolation",
     "admission_conservation",
     "class_capacity",
+    "shard_consistency",
 )
 
 
@@ -434,6 +442,23 @@ def check_cluster(
                     f"+ shed={c2['shed']}",
                 )
         report.info["admission"] = adm.snapshot()
+
+    # -- shard_consistency -------------------------------------------------
+    # Law 12: with a multi-chip mesh active, the device-resident capacity
+    # shards (per-shard incremental refresh, device/cache.py) re-gathered
+    # to host must equal the store-derived reference bitwise — including
+    # after mesh.shard_refresh_drop recovery. Skipped when no device view
+    # ever materialized (mesh off / single shard).
+    from ..utils.backend import get_mesh
+
+    cache = getattr(server, "device_cache", None)
+    if get_mesh().active and cache is not None:
+        mismatches = cache.verify_device_view()
+        if mismatches is not None:
+            report.checked["shard_consistency"] = True
+            for detail in mismatches:
+                report._fail("shard_consistency", "device_cache", detail)
+            report.info["device_cache"] = cache.device_counters()
 
     # context for the human-facing dump
     from ..resilience.breaker import snapshot_all

@@ -78,6 +78,10 @@ SITES: dict[str, tuple[str, ...]] = {
     # level to SHED for a bounded window mid-run — shed accounting
     # (invariant law 10) and NORMAL recovery must survive the flapping
     "admission.flap": ("force",),
+    # mesh sharding (device/cache.py): drop a per-shard incremental
+    # capacity upload — recovery must be a whole-tensor re-upload on
+    # the same access, never a stale device shard (invariant law 12)
+    "mesh.shard_refresh_drop": ("drop",),
 }
 
 FAULT_KINDS = (
@@ -105,6 +109,8 @@ _HORIZON = {
     "lane.handoff_delay": (0.25, 2),
     # hit once per controller re-eval tick, not per workload op
     "admission.flap": (0.5, 4),
+    # hit per cache device-view access with dirty regions pending
+    "mesh.shard_refresh_drop": (0.125, 2),
 }
 
 

@@ -49,16 +49,139 @@ class DeviceStateCache:
         self.incremental_refreshes = 0
         self.hits = 0
         self.stale_builds = 0  # older-than-resident snapshots (transient)
+        # mesh sharding: device-resident capacity, refreshed per shard.
+        # Dirty-REGION tracking (region ids are stable across incremental
+        # refreshes; only a full reflatten may re-sort rows) maps journal
+        # changes to the node-axis shards that must re-upload; clean
+        # shards keep their existing device buffers.
+        self._dev_capacity = None  # committed sharded jax.Array | None
+        self._dev_layout_gen = 0
+        self._dirty_regions: set[int] = set()
+        self.shard_uploads = 0  # per-shard (partial) device refreshes
+        self.full_uploads = 0  # whole-tensor device uploads
 
     # -- public -----------------------------------------------------------
     def tensors(self, snap) -> ClusterTensors:
+        from ..utils.backend import get_mesh
+
         with self._lock:
             ct = self._refresh_locked(snap)
-            return replace(ct, used=ct.used.copy())
+            out = replace(ct, used=ct.used.copy())
+            cfg = get_mesh()
+            if cfg.active:
+                out.device_capacity = self._device_capacity_locked(ct, cfg)
+            return out
 
     def invalidate(self) -> None:
         with self._lock:
             self._ct = None
+            self._dev_capacity = None
+            self._dirty_regions.clear()
+
+    def device_counters(self) -> dict:
+        with self._lock:
+            return {
+                "shard_uploads": self.shard_uploads,
+                "full_uploads": self.full_uploads,
+                "dirty_regions": len(self._dirty_regions),
+            }
+
+    def verify_device_view(self) -> list[str] | None:
+        """Invariant law 12 (shard_consistency) probe: re-gather every
+        device-resident capacity shard to host and compare *bitwise*
+        against the resident generation's store-derived capacity.
+        Returns None when no device view is materialized (mesh off, or
+        never accessed); else a list of mismatch details (empty ==
+        consistent). Pending dirty regions are fine — they re-upload on
+        the next access — but a shard that claims to be clean must
+        match."""
+        with self._lock:
+            ct = self._ct
+            arr = self._dev_capacity
+            if ct is None or arr is None:
+                return None
+            if self._dirty_regions:
+                # flush pending per-shard refreshes so the comparison
+                # sees what the next eval would read
+                from ..utils.backend import get_mesh
+
+                cfg = get_mesh()
+                if cfg.active:
+                    arr = self._device_capacity_locked(ct, cfg)
+            problems: list[str] = []
+            ref = np.asarray(ct.capacity)
+            for sh in arr.addressable_shards:
+                host = np.asarray(sh.data)
+                want = ref[sh.index]
+                if host.shape != want.shape or not np.array_equal(
+                    host, want
+                ):
+                    start = sh.index[0].start or 0
+                    problems.append(
+                        f"rows[{start}:{start + host.shape[0]}] on "
+                        f"{sh.device} diverge from store-derived capacity"
+                    )
+            return problems
+
+    # -- device view (mesh sharding) ---------------------------------------
+    def _device_capacity_locked(self, ct: ClusterTensors, cfg):
+        """Sharded device-resident capacity for the resident generation.
+        Steady-state node updates re-upload ONLY the shards whose regions
+        went dirty; layout changes (full reflatten) or a chaos-dropped
+        shard refresh fall back to a whole-tensor upload. Returns None
+        when the mesh doesn't divide the bucket (callers shard on the
+        fly)."""
+        import jax
+
+        from ..chaos.plane import chaos_site
+        from ..utils.backend import shard_put
+
+        mp = cfg.n_node_shards
+        pn = ct.padded_n
+        if mp <= 1 or pn % mp != 0 or ct.region_ids is None:
+            return None
+        if (
+            self._dev_capacity is None
+            or self._dev_layout_gen != ct.layout_gen
+            or self._dev_capacity.shape != ct.capacity.shape
+        ):
+            self._dev_capacity = shard_put(ct.capacity, ("nodes",), cfg)
+            self._dev_layout_gen = ct.layout_gen
+            self._dirty_regions.clear()
+            self.full_uploads += 1
+            return self._dev_capacity
+        if not self._dirty_regions:
+            return self._dev_capacity
+        if chaos_site("mesh.shard_refresh_drop") == "drop":
+            # a dropped shard upload must never serve stale capacity:
+            # recovery is a whole-tensor re-upload on this access
+            self._dev_capacity = shard_put(ct.capacity, ("nodes",), cfg)
+            self._dirty_regions.clear()
+            self.full_uploads += 1
+            return self._dev_capacity
+        seg = pn // mp
+        rows = np.flatnonzero(
+            np.isin(ct.region_ids, list(self._dirty_regions))
+        )
+        dirty_shards = {int(r) // seg for r in rows}
+        arr = self._dev_capacity
+        bufs = []
+        for sh in arr.addressable_shards:
+            start = sh.index[0].start or 0
+            if start // seg in dirty_shards:
+                bufs.append(
+                    jax.device_put(
+                        ct.capacity[start : start + seg], sh.device
+                    )
+                )
+            else:
+                bufs.append(sh.data)
+        self._dev_capacity = jax.make_array_from_single_device_arrays(
+            ct.capacity.shape, arr.sharding, bufs
+        )
+        self._dirty_regions.clear()
+        self.shard_uploads += 1
+        return self._dev_capacity
 
     # -- refresh machinery -------------------------------------------------
     def _rebuild_locked(self, snap) -> ClusterTensors:
@@ -144,6 +267,10 @@ class DeviceStateCache:
         ready = ct.ready.copy()
         dc_ids = ct.dc_ids.copy()
         class_ids = ct.class_ids.copy()
+        region_ids = (
+            ct.region_ids.copy() if ct.region_ids is not None else None
+        )
+        region_vocab = dict(ct.region_vocab)
         node_ids = list(ct.node_ids)
         nodes = list(ct.nodes)
         node_row = dict(ct.node_row)
@@ -178,6 +305,17 @@ class DeviceStateCache:
             capacity[row] = node_comparable_capacity(node).to_vector()
             ready[row] = node.ready()
             used[row] = _node_used(snap, node.id, dims)
+            if region_ids is not None:
+                # appended rows break strict region-major contiguity
+                # until the next full reflatten re-sorts; sharding
+                # correctness (hierarchical top-k) never depends on
+                # contiguity — only shard-locality of the prefilters does
+                from .flatten import _region_name, region_key
+
+                region_ids[row] = region_vocab.setdefault(
+                    _region_name(region_key(node)), len(region_vocab)
+                )
+                self._dirty_regions.add(int(region_ids[row]))
 
         for nid in node_keys:
             row = node_row[nid]
@@ -188,6 +326,8 @@ class DeviceStateCache:
             capacity[row] = node_comparable_capacity(node).to_vector()
             ready[row] = node.ready()
             used[row] = _node_used(snap, nid, dims)
+            if region_ids is not None:
+                self._dirty_regions.add(int(region_ids[row]))
 
         for nid in alloc_nodes:
             if nid in node_keys:
@@ -214,6 +354,8 @@ class DeviceStateCache:
             attr_cache=attr_cache,
             device_class_ids=device_class_ids,
             device_class_vocab=device_class_vocab,
+            region_ids=region_ids,
+            region_vocab=region_vocab,
             # incremental refresh never reorders existing rows (new nodes
             # append) — row-indexed overlays stay valid
             layout_gen=ct.layout_gen,

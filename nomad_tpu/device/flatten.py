@@ -42,6 +42,20 @@ def _check_constraint(node, c):
 _MIN_BUCKET = 8
 
 
+def region_key(node) -> tuple[str, str]:
+    """The region a node belongs to: (datacenter, device_class). Rows are
+    laid out region-major so a region's rows are contiguous and — with a
+    mesh active — land on as few node-axis shards as possible, keeping
+    per-shard feasibility prefilters local. The key is pure node identity
+    (no usage state), so it is stable across incremental refreshes; only
+    a full reflatten may re-sort."""
+    return (node.datacenter, getattr(node, "device_class", "") or "")
+
+
+def _region_name(key: tuple[str, str]) -> str:
+    return f"{key[0]}/{key[1]}" if key[1] else key[0]
+
+
 def node_bucket(n: int) -> int:
     b = _MIN_BUCKET
     while b < n:
@@ -93,6 +107,17 @@ class ClusterTensors:
     # a cache generation; refresh/rebuild construct a fresh empty dict,
     # which is exactly the staleness boundary.
     dc_ready_counts: dict = field(default_factory=dict)
+    # region axis (mesh sharding): per-row region ids, nondecreasing by
+    # construction (rows are sorted region-major), -1 on padding rows.
+    # None = hand-built tensors that never declared regions; treat as one
+    # region. region_vocab maps "dc[/device_class]" → id.
+    region_ids: np.ndarray | None = None  # i32[N]
+    region_vocab: dict[str, int] = field(default_factory=dict)
+    # device-resident sharded capacity for this generation (filled by
+    # DeviceStateCache when a mesh is active; None = shard on the fly).
+    # Shared by reference across the per-call used-copy wrappers — the
+    # buffer is immutable on device and regenerated per cache refresh.
+    device_capacity: object = None
     # row-layout generation: bumped ONLY by a full reflatten (which may
     # re-sort rows); preserved across incremental refreshes and the
     # per-call used-copy. Consumers holding row-indexed overlays (the
@@ -142,10 +167,14 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
     (scheduler/context.go:120-157), minus in-flight plan deltas which the
     scheduler overlays separately (see score.py's ``used`` argument).
     """
+    # Region-major row order — UNCONDITIONAL, so the single-device and
+    # sharded paths see the same rows in the same order and argmax
+    # tie-breaks agree bit-for-bit. Within a region, by node id (the
+    # pre-region order); single-dc classless clusters keep the exact
+    # pre-region layout.
     if nodes is None:
-        nodes = sorted(snap.nodes(), key=lambda n: n.id)
-    else:
-        nodes = sorted(nodes, key=lambda n: n.id)
+        nodes = snap.nodes()
+    nodes = sorted(nodes, key=lambda nd: (*region_key(nd), nd.id))
     n = len(nodes)
     pn = node_bucket(max(n, 1))
 
@@ -160,12 +189,17 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
     node_row: dict[str, int] = {}
     device_class_ids = np.zeros(pn, dtype=np.int32)
     device_class_vocab: dict[str, int] = {"": 0}
+    region_ids = np.full(pn, -1, dtype=np.int32)
+    region_vocab: dict[str, int] = {}
 
     for i, node in enumerate(nodes):
         node_row[node.id] = i
         capacity[i] = node_comparable_capacity(node).to_vector()
         ready[i] = node.ready()
         dc_ids[i] = dc_vocab.setdefault(node.datacenter, len(dc_vocab))
+        region_ids[i] = region_vocab.setdefault(
+            _region_name(region_key(node)), len(region_vocab)
+        )
         device_class_ids[i] = device_class_vocab.setdefault(
             getattr(node, "device_class", ""), len(device_class_vocab)
         )
@@ -196,6 +230,8 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
         nodes=list(nodes),
         device_class_ids=device_class_ids,
         device_class_vocab=device_class_vocab,
+        region_ids=region_ids,
+        region_vocab=region_vocab,
     )
 
 

@@ -63,7 +63,7 @@ import numpy as np
 
 from ..obs.trace import global_tracer as _tracer
 from ..structs.resources import BINPACK_MAX_SCORE
-from ..utils.backend import traced_jit
+from ..utils.backend import get_mesh, shard_put, traced_jit
 
 # Retrace budgets (nomad_tpu.analysis.retrace): the per-kernel trace
 # count a representative bench batch may reach. Every dynamic dimension
@@ -93,6 +93,28 @@ CHUNK = 16
 
 def _pow10(x):
     return jnp.exp(_LN10 * x)
+
+
+def _topk_nodes(flat, k: int, n_shards: int = 1):
+    """Top-k over the flattened node-major [N*J] plane, hierarchically
+    when the node axis is sharded: per-shard local top-k, then one
+    cross-shard merge over the [S·k'] candidates. BIT-IDENTICAL to the
+    global ``lax.top_k`` by construction — ``lax.top_k`` orders by
+    (value desc, index asc), each shard forwards a prefix of its own such
+    order (min(k, seg) entries always covers the global winners, ties
+    included), and candidates are concatenated shard-major so the merge's
+    lowest-candidate-position tie-break IS the lowest-global-index
+    tie-break. ``n_shards`` is static; 1 (or a non-dividing length)
+    Python-gates to the plain global top_k, leaving the single-device
+    jaxpr untouched."""
+    if n_shards <= 1 or flat.shape[0] % n_shards != 0:
+        return jax.lax.top_k(flat, k)
+    seg = flat.shape[0] // n_shards
+    k_local = min(k, seg)
+    lv, li = jax.lax.top_k(flat.reshape(n_shards, seg), k_local)
+    gi = li + (jnp.arange(n_shards, dtype=li.dtype) * seg)[:, None]
+    mv, mpos = jax.lax.top_k(lv.reshape(-1), k)
+    return mv, gi.reshape(-1)[mpos]
 
 
 def _unpack_mask(packed, n: int):
@@ -281,7 +303,7 @@ def _score_planes(
 
 
 @functools.partial(traced_jit, retrace_budget=RETRACE_BUDGET,
-                   static_argnames=("max_j", "k"))
+                   static_argnames=("max_j", "k", "n_shards"))
 def place_closed_form_kernel(
     capacity,  # f32[N, D] shared
     used0,  # f32[N, D] shared snapshot usage
@@ -299,6 +321,7 @@ def place_closed_form_kernel(
     max_j: int,  # static: max instances of one group per node
     k: int,  # static: top-k width (≥ max count in batch + overflow)
     jitter=None,  # f32[N] tie-break noise, shared across lanes
+    n_shards: int = 1,  # static: node-axis mesh shards (hierarchical top-k)
 ):
     """Returns (choices i32[G, k], scores f32[G, k]) in greedy order.
     Entries past a lane's feasible candidates are −1/−inf; entries in
@@ -321,7 +344,9 @@ def place_closed_form_kernel(
         flat_sel = s_sel.reshape(-1)  # [N*J]
         flat_raw = s_raw.reshape(-1)
         k_eff = min(k, flat_sel.shape[0])  # tiny clusters: < k slots total
-        top_sel, top_idx = jax.lax.top_k(flat_sel, k_eff)
+        # node-major flattening keeps each shard's rows contiguous in
+        # flat index space, so the hierarchical reduction applies as-is
+        top_sel, top_idx = _topk_nodes(flat_sel, k_eff, n_shards)
         if k_eff < k:
             pad = k - k_eff
             top_sel = jnp.concatenate(
@@ -510,7 +535,7 @@ def place_value_scan_kernel(
 
 
 @functools.partial(traced_jit, retrace_budget=RETRACE_BUDGET,
-                   static_argnames=("max_j", "chunk", "n_chunks"))
+                   static_argnames=("max_j", "chunk", "n_chunks", "n_shards"))
 def place_spread_chunked_kernel(
     capacity,  # f32[N, D] shared
     used0,  # f32[N, D] shared snapshot usage
@@ -535,6 +560,7 @@ def place_spread_chunked_kernel(
     chunk: int,
     n_chunks: int,
     jitter=None,  # f32[N] tie-break noise
+    n_shards: int = 1,  # static: node-axis mesh shards (hierarchical top-k)
 ):
     """Chunked greedy placement for large spread-coupled groups.
 
@@ -607,7 +633,7 @@ def place_spread_chunked_kernel(
             s_sel = jax.lax.associative_scan(jnp.minimum, s_for_min, axis=1)
             s_sel = jnp.where(feas, s_sel, -jnp.inf)
 
-            vals, idx = jax.lax.top_k(s_sel.reshape(-1), chunk)
+            vals, idx = _topk_nodes(s_sel.reshape(-1), chunk, n_shards)
             take = (jnp.arange(chunk) + n_placed < count) & (vals > -jnp.inf)
             rows = (idx // max_j).astype(jnp.int32)
             true_scores = s_raw.reshape(-1)[idx]
@@ -1035,6 +1061,45 @@ def _shared_batch(asks: list, pn: int) -> dict:
     )
 
 
+# PartitionSpec axes per batch tensor (mesh sharding seam): groups ride
+# data-parallel, dense per-node columns shard on the node axis. Packed u8
+# masks and [G, 1] degenerate broadcasts keep their trailing axes
+# replicated (shard_put skips any axis the mesh size doesn't divide).
+_BATCH_SPECS = {
+    "asks": ("groups",),
+    "eligible": ("groups",),
+    "job_counts": ("groups", "nodes"),
+    "desired_totals": ("groups",),
+    "penalty_nodes": ("groups",),
+    "affinity_scores": ("groups", "nodes"),
+    "has_affinities": ("groups",),
+    "distinct_hosts": ("groups",),
+    "slot_caps": ("groups", "nodes"),
+    "counts": ("groups",),
+    "block_value_ids": ("groups", None, "nodes"),
+    "block_counts0": ("groups",),
+    "block_desired": ("groups",),
+    "block_caps": ("groups",),
+    "block_weights": ("groups",),
+    "block_kinds": ("groups",),
+    "throughputs": ("groups", "nodes"),
+}
+
+
+def _device_batch(batch: dict, cfg=None) -> dict:
+    """Upload a host batch dict through the sharding seam: NamedSharding
+    placement when a mesh is active, plain jnp.asarray otherwise (the
+    degenerate path is byte-for-byte the pre-mesh upload)."""
+    if cfg is None:
+        cfg = get_mesh()
+    if not cfg.active:
+        return {k: jnp.asarray(v) for k, v in batch.items()}
+    return {
+        k: shard_put(v, _BATCH_SPECS.get(k, ()), cfg)
+        for k, v in batch.items()
+    }
+
+
 @dataclass
 class PlacementResult:
     """Host-side result for one group: chosen node rows (−1 = failed) and
@@ -1060,10 +1125,37 @@ class PlacementKernel:
     compiled kernel, unpacks results. Shape-bucketed so node churn and
     varying batch sizes hit a small set of compiled programs."""
 
-    def __init__(self, algorithm: str = "binpack", force_scan: bool = False):
+    def __init__(
+        self,
+        algorithm: str = "binpack",
+        force_scan: bool = False,
+        mesh=None,  # utils.backend.MeshConfig override; None = process mesh
+    ):
         self.algorithm = algorithm
         self.algorithm_spread = algorithm == "spread"
         self.force_scan = force_scan  # parity testing: disable the fast path
+        self._mesh = mesh
+
+    def mesh_cfg(self):
+        return self._mesh if self._mesh is not None else get_mesh()
+
+    def _n_shards(self, pn: int) -> int:
+        """Static node-axis shard count for the hierarchical top-k; 1
+        unless the mesh is active AND divides the padded bucket (pn is a
+        power of two ≥ 8 and mp is a power of two, so a non-dividing mp
+        means mp > pn — a tiny cluster on a big mesh)."""
+        cfg = self.mesh_cfg()
+        mp = cfg.n_node_shards
+        return mp if mp > 1 and pn % mp == 0 else 1
+
+    @staticmethod
+    def _capacity_dev(cluster, cfg):
+        """The DeviceStateCache's per-shard-refreshed capacity buffer
+        when one rode along on the tensors; else upload via the seam."""
+        dev = getattr(cluster, "device_capacity", None)
+        if dev is not None and cfg.active:
+            return dev
+        return shard_put(cluster.capacity, ("nodes",), cfg)
 
     def place(
         self,
@@ -1165,12 +1257,27 @@ class PlacementKernel:
             # not part of the score semantics being explained.
             from ..obs.explain import explain_group
 
+            sharded = self.mesh_cfg().n_node_shards > 1
             for a, res in zip(asks, out):
                 if res is not None:
+                    cand = None
+                    if sharded:
+                        # node axis sharded: rank only the candidate
+                        # columns the kernel actually surfaced (primary +
+                        # overflow) instead of gathering full score rows
+                        # back to host — the per-shard top-k union
+                        # provably contains every global winner
+                        cand = np.unique(
+                            np.concatenate(
+                                [res.node_rows, res.overflow_rows]
+                            )
+                        )
+                        cand = cand[cand >= 0]
                     res.explanation = explain_group(
                         cluster, a, used0,
                         algorithm=self.algorithm,
                         algorithm_spread=self.algorithm_spread,
+                        candidate_rows=cand,
                     )
         return out
 
@@ -1244,15 +1351,19 @@ class PlacementKernel:
         real_n = len(asks)
         asks = _pad_group_axis(asks, pn)
         batch = _shared_batch(asks, pn)
+        cfg = self.mesh_cfg()
         fused = np.array(
             place_closed_form_kernel(
-                jnp.asarray(cluster.capacity),
-                jnp.asarray(used0),
-                **{kk: jnp.asarray(v) for kk, v in batch.items()},
+                self._capacity_dev(cluster, cfg),
+                shard_put(used0, ("nodes",), cfg),
+                **_device_batch(batch, cfg),
                 algorithm_spread=jnp.asarray(self.algorithm_spread),
                 max_j=max_j,
                 k=k,
-                jitter=None if jitter is None else jnp.asarray(jitter),
+                jitter=None
+                if jitter is None
+                else shard_put(jitter, ("nodes",), cfg),
+                n_shards=self._n_shards(pn),
             )
         )
         choices = fused[:, :k]  # writable copies: repair mutates rows
@@ -1292,14 +1403,17 @@ class PlacementKernel:
             np.array([a.count for a in asks]) > 0, batch["counts"], 0
         ).astype(np.int32)
         batch.update(pad_value_blocks([a.blocks for a in asks], pn))
+        cfg = self.mesh_cfg()
         choices, scores = place_value_scan_kernel(
-            jnp.asarray(cluster.capacity),
-            jnp.asarray(used0),
-            **{k: jnp.asarray(v) for k, v in batch.items()},
+            self._capacity_dev(cluster, cfg),
+            shard_put(used0, ("nodes",), cfg),
+            **_device_batch(batch, cfg),
             algorithm_spread=jnp.asarray(self.algorithm_spread),
             max_j=max_j,
             max_steps=max_steps,
-            jitter=None if jitter is None else jnp.asarray(jitter),
+            jitter=None
+            if jitter is None
+            else shard_put(jitter, ("nodes",), cfg),
         )
         return self._unpack_coupled(choices, scores, asks[:real_n], overflow)
 
@@ -1329,15 +1443,19 @@ class PlacementKernel:
             np.array([a.count for a in asks]) > 0, batch["counts"], 0
         ).astype(np.int32)
         batch.update(pad_value_blocks([a.blocks for a in asks], pn))
+        cfg = self.mesh_cfg()
         choices, scores = place_spread_chunked_kernel(
-            jnp.asarray(cluster.capacity),
-            jnp.asarray(used0),
-            **{k: jnp.asarray(v) for k, v in batch.items()},
+            self._capacity_dev(cluster, cfg),
+            shard_put(used0, ("nodes",), cfg),
+            **_device_batch(batch, cfg),
             algorithm_spread=jnp.asarray(self.algorithm_spread),
             max_j=max_j,
             chunk=CHUNK,
             n_chunks=n_chunks,
-            jitter=None if jitter is None else jnp.asarray(jitter),
+            jitter=None
+            if jitter is None
+            else shard_put(jitter, ("nodes",), cfg),
+            n_shards=self._n_shards(pn),
         )
         return self._unpack_coupled(choices, scores, asks[:real_n], overflow)
 
@@ -1406,16 +1524,19 @@ class PlacementKernel:
         batch["counts"] = np.where(
             np.array([a.count for a in asks]) > 0, batch["counts"], 0
         ).astype(np.int32)
+        cfg = self.mesh_cfg()
         choices, scores = place_spread_opv_kernel(
-            jnp.asarray(cluster.capacity),
-            jnp.asarray(used0),
-            **{k: jnp.asarray(v) for k, v in batch.items()},
+            self._capacity_dev(cluster, cfg),
+            shard_put(used0, ("nodes",), cfg),
+            **_device_batch(batch, cfg),
             enforce_idx=jnp.asarray(enforce_idx),
             algorithm_spread=jnp.asarray(self.algorithm_spread),
             max_j=max_j,
             k_seg=k_seg,
             n_chunks=n_chunks,
-            jitter=None if jitter is None else jnp.asarray(jitter),
+            jitter=None
+            if jitter is None
+            else shard_put(jitter, ("nodes",), cfg),
         )
         return self._unpack_coupled(choices, scores, asks[:real_n], overflow)
 

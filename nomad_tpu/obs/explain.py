@@ -153,35 +153,43 @@ def _feasibility(capacity, used, a, n: int, throughputs=None):
 
 def _final_vector(
     capacity, used, a, n: int, fits, counts, algorithm_spread,
-    throughputs=None, desired_total=None,
+    throughputs=None, desired_total=None, rows=None,
 ):
     """Vectorized first-instance final score f32[n] (-inf infeasible) —
     the ranking pass. Same formulation as device.score._rescore_pick
     (the host oracle conflict repair already trusts) so the candidate
-    order agrees with what greedy placement picks."""
+    order agrees with what greedy placement picks.
+
+    ``rows`` (i64[m], ascending) restricts the pass to a candidate
+    subset — the sharded-node-axis path, where pulling full score rows
+    back to host would defeat the mesh; the returned vector is then
+    length m, aligned with ``rows``, and ``fits`` must already be
+    row-aligned."""
     from ..device.score import (
         BLOCK_DISTINCT_CAP,
         _host_block_tables,
     )
 
-    prop = used[:n] + a.ask[None, :]
+    idx = slice(None, n) if rows is None else rows
+    m = n if rows is None else len(rows)
+    prop = used[idx] + a.ask[None, :]
     free = np.where(
-        capacity[:n] > 0,
-        (capacity[:n] - prop) / np.maximum(capacity[:n], 1e-9),
+        capacity[idx] > 0,
+        (capacity[idx] - prop) / np.maximum(capacity[idx], 1e-9),
         1.0,
     )
     pow_sum = 10.0 ** free[:, 0] + 10.0 ** free[:, 1]
     binpack = np.clip(20.0 - pow_sum, 0.0, BINPACK_MAX_SCORE)
     spread_fit = np.clip(pow_sum - 2.0, 0.0, BINPACK_MAX_SCORE)
     fit = (spread_fit if algorithm_spread else binpack) / BINPACK_MAX_SCORE
-    jc = np.asarray(a.job_counts[:n])
+    jc = np.asarray(a.job_counts)[idx]
     coll = jc.astype(np.float32)
     dt = a.desired_total if desired_total is None else desired_total
     anti = np.where(jc > 0, -(coll + 1.0) / max(dt, 1.0), 0.0)
-    pen = np.asarray(a.penalty_nodes[:n], dtype=bool)
+    pen = np.asarray(a.penalty_nodes, dtype=bool)[idx]
     resched = np.where(pen, -1.0, 0.0)
-    aff = a.affinity_scores[:n] if a.has_affinities else 0.0
-    boost = np.zeros(n, dtype=np.float32)
+    aff = a.affinity_scores[idx] if a.has_affinities else 0.0
+    boost = np.zeros(m, dtype=np.float32)
     has_spread_any = False
     if a.blocks is not None and counts is not None:
         tbl_boost, _allow = _host_block_tables(counts, a.blocks)
@@ -189,7 +197,7 @@ def _final_vector(
             if a.blocks.kinds[b] == BLOCK_DISTINCT_CAP:
                 continue
             has_spread_any = True
-            vids = a.blocks.value_ids[b, :n]
+            vids = a.blocks.value_ids[b][idx]
             safe = np.maximum(vids, 0)
             boost += np.where(vids >= 0, tbl_boost[b][safe], -1.0)
     spread_on = has_spread_any & (boost != 0.0)
@@ -202,7 +210,7 @@ def _final_vector(
         + spread_on
     )
     if throughputs is not None:
-        tp = np.asarray(throughputs[:n])
+        tp = np.asarray(throughputs)[idx]
         num = num + tp
         den = den + 1.0
     return np.where(fits, num / den, -np.inf)
@@ -284,13 +292,22 @@ def explain_group(
     throughputs=None,
     top_k: int = DEFAULT_TOP_K,
     desired_total=None,
+    candidate_rows=None,
 ) -> PlacementExplanation:
     """Build the candidate/rejection explanation for one group ask
     against the usage snapshot the kernel pass scored with.
 
     ``throughputs`` is the pre-normalized [0, 1] heterogeneity axis when
     the *scoring* path consumed one (score_group); the base placement
-    kernels ignore the axis, so their explanations do too."""
+    kernels ignore the axis, so their explanations do too.
+
+    ``candidate_rows`` (ascending node rows) restricts the RANKING pass
+    to the columns the kernel's hierarchical top-k already surfaced —
+    the node-axis-sharded path, where the per-shard top-k union provably
+    contains every global winner, so ranking the union ranks the same
+    top candidates without gathering full score rows to host. The
+    rejection histogram stays a full host-side pass either way (it reads
+    the flattened ask masks, not device score rows)."""
     n = cluster.num_nodes
     capacity = np.asarray(cluster.capacity)
     used = np.asarray(used0)
@@ -306,13 +323,34 @@ def explain_group(
     if not fits.any() or a.count <= 0:
         return ex
     counts = a.blocks.counts0 if a.blocks is not None else None
-    finals = _final_vector(
-        capacity, used, a, n, fits, counts, algorithm_spread, throughputs,
-        desired_total,
-    )
-    # stable sort: ties keep row order, matching argmax's first-index win
-    order = np.argsort(-finals, kind="stable")[: max(top_k, 1)]
-    order = order[finals[order] > -np.inf]
+    if candidate_rows is not None:
+        rows = np.asarray(candidate_rows, dtype=np.int64)
+        rows = np.unique(rows[(rows >= 0) & (rows < n)])
+        if rows.size == 0:
+            return ex
+        finals = _final_vector(
+            capacity, used, a, n, fits[rows], counts, algorithm_spread,
+            throughputs, desired_total, rows=rows,
+        )
+        # stable sort over ascending rows: ties keep row order, matching
+        # argmax's first-index win (the subset inherits the full
+        # ranking's tie-break because rows are ascending)
+        pick = np.argsort(-finals, kind="stable")[: max(top_k, 1)]
+        pick = pick[finals[pick] > -np.inf]
+        order = rows[pick]
+        finals_by_row = {int(r): finals[i] for i, r in enumerate(rows)}
+        finals = np.full(n, -np.inf, dtype=np.float32)
+        for r, f in finals_by_row.items():
+            finals[r] = f
+    else:
+        finals = _final_vector(
+            capacity, used, a, n, fits, counts, algorithm_spread,
+            throughputs, desired_total,
+        )
+        # stable sort: ties keep row order, matching argmax's
+        # first-index win
+        order = np.argsort(-finals, kind="stable")[: max(top_k, 1)]
+        order = order[finals[order] > -np.inf]
     breakdown = _components_at(
         capacity, used, a, order, np.zeros(len(order)), counts,
         algorithm_spread, throughputs, desired_total,

@@ -206,6 +206,20 @@ class RPCClient:
         # request — exactly the window where idempotency matters
         if chaos_site("rpc.conn_drop") == "drop":
             conn.close()
+            # the response is lost even if the kernel already buffered
+            # it — discard any raced-in reply so the fault is
+            # deterministic regardless of scheduler timing; mark the
+            # conn dead now so the retry dials fresh instead of racing
+            # the reader thread's own dead.set()
+            conn.dead.set()
+            with conn.pending_lock:
+                conn.pending.pop(seq, None)
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            q.put({"error": "connection closed"})
         return conn, seq, q
 
     def _call_once(

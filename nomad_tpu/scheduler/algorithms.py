@@ -44,7 +44,11 @@ class SchedulerAlgorithm:
     # the API surfaces this so operators know what a selection changes
     requires_device_classes: bool = False
 
-    def make_kernel(self, force_scan: bool = False):
+    def make_kernel(self, force_scan: bool = False, mesh=None):
+        """``mesh`` is a utils.backend.MeshConfig override; None means
+        the kernel binds the process-wide mesh (get_mesh()) — the seam
+        through which the production scheduler path inherits multi-chip
+        sharding without any per-scheduler wiring."""
         raise NotImplementedError
 
 
@@ -76,9 +80,9 @@ def get_algorithm(name: str) -> SchedulerAlgorithm:
     return algo
 
 
-def make_kernel(name: str, force_scan: bool = False):
+def make_kernel(name: str, force_scan: bool = False, mesh=None):
     """The factory seam: scheduler_algorithm config string → kernel."""
-    return get_algorithm(name).make_kernel(force_scan)
+    return get_algorithm(name).make_kernel(force_scan, mesh=mesh)
 
 
 # -- built-ins ---------------------------------------------------------------
@@ -89,10 +93,10 @@ class BinpackAlgorithm(SchedulerAlgorithm):
     name = "binpack"
     description = "maximize per-node utilization (reference default)"
 
-    def make_kernel(self, force_scan: bool = False):
+    def make_kernel(self, force_scan: bool = False, mesh=None):
         from ..device.score import PlacementKernel
 
-        return PlacementKernel("binpack", force_scan)
+        return PlacementKernel("binpack", force_scan, mesh=mesh)
 
 
 @register_algorithm
@@ -100,20 +104,20 @@ class SpreadAlgorithm(SchedulerAlgorithm):
     name = "spread"
     description = "prefer empty nodes (inverse binpack fit)"
 
-    def make_kernel(self, force_scan: bool = False):
+    def make_kernel(self, force_scan: bool = False, mesh=None):
         from ..device.score import PlacementKernel
 
-        return PlacementKernel("spread", force_scan)
+        return PlacementKernel("spread", force_scan, mesh=mesh)
 
 
 class _HeteroAlgorithm(SchedulerAlgorithm):
     requires_device_classes = True
     policy = ""
 
-    def make_kernel(self, force_scan: bool = False):
+    def make_kernel(self, force_scan: bool = False, mesh=None):
         from .hetero import HeteroPlacementKernel
 
-        return HeteroPlacementKernel(self.policy, force_scan)
+        return HeteroPlacementKernel(self.policy, force_scan, mesh=mesh)
 
 
 @register_algorithm
@@ -159,7 +163,9 @@ def score_group(
     ``obs.explain.PlacementExplanation`` carrying top-k candidates and
     the feasibility-rejection histogram."""
     from ..device.score import score_matrix_kernel
+    from ..utils.backend import get_mesh, shard_put
 
+    cfg = get_mesh()
     throughputs = None
     if ga.has_throughputs and ga.throughputs is not None:
         tp = ga.throughputs.astype(np.float32)
@@ -167,18 +173,20 @@ def score_group(
         if best > 0.0:
             throughputs = (tp / np.float32(best))[None, :]
     finals, fits = score_matrix_kernel(
-        np.asarray(ct.capacity),
-        np.asarray(ct.used),
-        ga.ask[None, :],
-        ga.eligible[None, :],
-        ga.job_counts[None, :],
+        shard_put(np.asarray(ct.capacity), ("nodes",), cfg),
+        shard_put(np.asarray(ct.used), ("nodes",), cfg),
+        shard_put(ga.ask[None, :], ("groups",), cfg),
+        shard_put(ga.eligible[None, :], ("groups", "nodes"), cfg),
+        shard_put(ga.job_counts[None, :], ("groups", "nodes"), cfg),
         np.array([float(max(desired_total, 1))], dtype=np.float32),
-        ga.penalty_nodes[None, :],
-        ga.affinity_scores[None, :],
+        shard_put(ga.penalty_nodes[None, :], ("groups", "nodes"), cfg),
+        shard_put(ga.affinity_scores[None, :], ("groups", "nodes"), cfg),
         np.array([ga.has_affinities]),
         np.array([ga.distinct_hosts]),
         np.asarray(algorithm_spread),
-        throughputs,
+        None
+        if throughputs is None
+        else shard_put(throughputs, ("groups", "nodes"), cfg),
     )
     if not explain:
         return np.asarray(finals)[0], np.asarray(fits)[0]

@@ -303,7 +303,7 @@ class HeteroPlacementKernel:
     device-slot caps) delegates to the base binpack kernel so behavior
     degrades to exactly the pre-heterogeneity placement."""
 
-    def __init__(self, policy: str, force_scan: bool = False):
+    def __init__(self, policy: str, force_scan: bool = False, mesh=None):
         from ..device.score import PlacementKernel
 
         if policy not in POLICY_IDS:
@@ -312,7 +312,13 @@ class HeteroPlacementKernel:
         self.policy_id = POLICY_IDS[policy]
         self.algorithm_spread = False
         self.force_scan = force_scan
-        self._base = PlacementKernel("binpack", force_scan)
+        self._mesh = mesh
+        self._base = PlacementKernel("binpack", force_scan, mesh=mesh)
+
+    def mesh_cfg(self):
+        from ..utils.backend import get_mesh
+
+        return self._mesh if self._mesh is not None else get_mesh()
 
     def _hetero_eligible(self, cluster, asks: list) -> bool:
         if not getattr(cluster, "has_device_classes", False):
@@ -336,14 +342,17 @@ class HeteroPlacementKernel:
         batch = build_hetero_batch(
             cluster, asks, used_override=kwargs.get("used_override")
         )
+        from ..utils.backend import shard_put
+
+        cfg = self.mesh_cfg()
         choices, choice_tp, _ = hetero_place_kernel(
-            batch.capacity,
-            batch.used,
-            batch.asks,
-            batch.counts,
-            batch.eligible,
-            batch.tp,
-            batch.tpmax,
+            shard_put(batch.capacity, ("nodes",), cfg),
+            shard_put(batch.used, ("nodes",), cfg),
+            shard_put(batch.asks, ("groups",), cfg),
+            shard_put(batch.counts, ("groups",), cfg),
+            shard_put(batch.eligible, ("groups", "nodes"), cfg),
+            shard_put(batch.tp, ("groups", "nodes"), cfg),
+            shard_put(batch.tpmax, ("groups",), cfg),
             batch.cost,
             policy=self.policy_id,
             steps=batch.steps,

@@ -355,6 +355,182 @@ def probe_device_count_cached(
     return n, diag
 
 
+# -- mesh sharding seam -------------------------------------------------------
+#
+# The ONE place the repo constructs a jax Mesh / NamedSharding and calls
+# jax.device_put on pipeline tensors (NTA015 bans it elsewhere in
+# device/ and scheduler/). Axis names match tests/test_mesh_sharding.py:
+# "groups" is data-parallel over the eval/group axis, "nodes" shards the
+# node axis region-major. The degenerate 1x1 mesh keeps get_mesh()
+# callable everywhere while leaving the single-device jaxpr — and thus
+# placements — bit-identical.
+
+_MESH_ENV = "NOMAD_TPU_MESH"
+
+_mesh_lock = threading.Lock()
+_mesh_config = None  # cached MeshConfig | None (None = not resolved yet)
+
+
+class MeshConfig:
+    """Resolved mesh decision. ``mesh`` is a ``jax.sharding.Mesh`` when
+    ``active``, else None; ``dp``/``mp`` are the groups/nodes axis sizes
+    (1,1 when degenerate)."""
+
+    __slots__ = ("mesh", "dp", "mp", "source")
+
+    def __init__(self, mesh, dp: int, mp: int, source: str):
+        self.mesh = mesh
+        self.dp = int(dp)
+        self.mp = int(mp)
+        self.source = source
+
+    @property
+    def active(self) -> bool:
+        return self.mesh is not None
+
+    @property
+    def n_node_shards(self) -> int:
+        return self.mp if self.mesh is not None else 1
+
+    def describe(self) -> dict:
+        """The self-describing ``mesh`` block bench.py embeds in every
+        JSON record (per-shard node counts are filled in by the caller
+        that knows the padded bucket)."""
+        return {
+            "active": self.active,
+            "shape": [self.dp, self.mp],
+            "axis_names": ["groups", "nodes"],
+            "source": self.source,
+        }
+
+
+def parse_mesh_spec(spec: str):
+    """``NOMAD_TPU_MESH`` grammar: ``off``/``0`` (degenerate), ``auto``
+    (shape from all visible devices), or ``dp,mp``. Returns "off",
+    "auto", or an (dp, mp) int tuple; raises ValueError on junk."""
+    s = (spec or "").strip().lower()
+    if s in ("off", "0", "none"):
+        return "off"
+    if s == "auto":
+        return "auto"
+    parts = s.split(",")
+    if len(parts) != 2:
+        raise ValueError(
+            f"bad {_MESH_ENV}={spec!r}: expected 'dp,mp', 'auto', or 'off'"
+        )
+    dp, mp = int(parts[0]), int(parts[1])
+    if dp < 1 or mp < 1:
+        raise ValueError(f"bad {_MESH_ENV}={spec!r}: axes must be >= 1")
+    if mp & (mp - 1):
+        raise ValueError(
+            f"bad {_MESH_ENV}={spec!r}: nodes axis must be a power of two "
+            "(it must divide the padded node bucket)"
+        )
+    return (dp, mp)
+
+
+def auto_mesh_shape(n_devices: int) -> tuple[int, int]:
+    """Shape rule for ``auto``: use the largest power-of-two device
+    count, cap the node axis at 8 (the minimum node bucket), put the
+    rest on the groups axis. 8 devices -> (2, 4)."""
+    total = 1
+    while total * 2 <= n_devices:
+        total *= 2
+    if total <= 1:
+        return (1, 1)
+    mp = min(8, total // 2) if total > 2 else total
+    dp = total // mp
+    return (dp, mp)
+
+
+def _resolve_mesh() -> "MeshConfig":
+    spec = os.environ.get(_MESH_ENV)
+    if spec is None:
+        # Unset: activate automatically only on a real accelerator
+        # backend with >1 device — the production default. The CPU test
+        # rig (8 virtual host devices) stays degenerate unless a test
+        # opts in, so the single-device jaxpr suite is undisturbed.
+        import jax
+
+        if jax.default_backend() == "cpu" or len(jax.devices()) <= 1:
+            return MeshConfig(None, 1, 1, "default-off")
+        parsed = "auto"
+        source = "auto-detected"
+    else:
+        parsed = parse_mesh_spec(spec)
+        source = f"env:{spec.strip()}"
+    if parsed == "off":
+        return MeshConfig(None, 1, 1, source)
+    import jax
+
+    devices = jax.devices()
+    if parsed == "auto":
+        dp, mp = auto_mesh_shape(len(devices))
+    else:
+        dp, mp = parsed
+    if dp * mp > len(devices):
+        raise ValueError(
+            f"{_MESH_ENV} asks for {dp}x{mp}={dp * mp} devices but only "
+            f"{len(devices)} are visible"
+        )
+    if dp * mp == 1:
+        return MeshConfig(None, 1, 1, source)
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    grid = _np.array(devices[: dp * mp]).reshape(dp, mp)
+    return MeshConfig(Mesh(grid, ("groups", "nodes")), dp, mp, source)
+
+
+def get_mesh() -> "MeshConfig":
+    """The process-wide mesh decision, resolved once from
+    ``NOMAD_TPU_MESH`` (see ``_resolve_mesh``). Call ``reset_mesh()``
+    after changing the env in tests."""
+    global _mesh_config
+    cfg = _mesh_config
+    if cfg is not None:
+        return cfg
+    with _mesh_lock:
+        if _mesh_config is None:
+            _mesh_config = _resolve_mesh()
+        return _mesh_config
+
+
+def reset_mesh() -> None:
+    global _mesh_config
+    with _mesh_lock:
+        _mesh_config = None
+
+
+def shard_put(x, axes, cfg: "MeshConfig | None" = None):
+    """Place ``x`` on the mesh with PartitionSpec(*axes); the sanctioned
+    device_put seam. ``axes`` entries are "groups"/"nodes"/None, one per
+    array dim (trailing Nones may be omitted). Degenerate mesh or an
+    axis size that does not divide the corresponding dim -> plain
+    jnp.asarray (full replication semantics, unchanged jaxpr)."""
+    import jax.numpy as jnp
+
+    if cfg is None:
+        cfg = get_mesh()
+    if not cfg.active:
+        return jnp.asarray(x)
+    shape = getattr(x, "shape", None)
+    if shape is None:
+        x = jnp.asarray(x)
+        shape = x.shape
+    sizes = {"groups": cfg.dp, "nodes": cfg.mp}
+    use = []
+    for i, ax in enumerate(axes):
+        if ax is None or i >= len(shape) or shape[i] % sizes[ax] != 0:
+            use.append(None)
+        else:
+            use.append(ax)
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.device_put(x, NamedSharding(cfg.mesh, PartitionSpec(*use)))
+
+
 def cpu_fallback_env(n_devices: int | None = None) -> dict:
     """A copy of os.environ steered to the CPU backend: JAX_PLATFORMS=cpu,
     the axon sitecustomize stripped from PYTHONPATH, and (optionally) a

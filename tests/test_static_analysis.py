@@ -29,6 +29,7 @@ from nomad_tpu.analysis.rules.laneowner import LaneOwnerDiscipline
 from nomad_tpu.analysis.rules.lockfields import LockDiscipline
 from nomad_tpu.analysis.rules.mergedsubmit import MergedSubmitDiscipline
 from nomad_tpu.analysis.rules.planfreeze import PlanMutationAfterSubmit
+from nomad_tpu.analysis.rules.shardingseam import ShardingSeamDiscipline
 from nomad_tpu.analysis.rules.spans import SpanCoverage
 from nomad_tpu.analysis.rules.swallow import SilentExceptionSwallow
 from nomad_tpu.analysis.rules.wallclock import BareWallClockInBrokerServer
@@ -740,6 +741,69 @@ class TestNTA013:
             ), rel
 
 
+# -- NTA015: device placement goes through the mesh sharding seam ----------
+
+
+class TestNTA015:
+    BAD = (
+        "import jax\n"
+        "def upload(ct):\n"
+        "    return jax.device_put(ct.capacity)\n"
+    )
+
+    def test_bare_device_put_in_device_triggers(self):
+        fs = run(self.BAD, "nomad_tpu/device/custom.py",
+                 ShardingSeamDiscipline)
+        assert rule_ids(fs) == ["NTA015"]
+        assert fs[0].symbol == "upload"
+
+    def test_direct_named_sharding_in_scheduler_triggers(self):
+        src = (
+            "from jax.sharding import NamedSharding, PartitionSpec\n"
+            "def pin(mesh, x):\n"
+            "    s = NamedSharding(mesh, PartitionSpec('nodes'))\n"
+            "    return x, s\n"
+        )
+        fs = run(src, "nomad_tpu/scheduler/custom.py",
+                 ShardingSeamDiscipline)
+        assert rule_ids(fs) == ["NTA015", "NTA015"]
+
+    def test_shard_put_routed_placement_is_clean(self):
+        src = (
+            "from ..utils.backend import get_mesh, shard_put\n"
+            "def upload(ct):\n"
+            "    return shard_put(ct.capacity, ('nodes',), get_mesh())\n"
+        )
+        assert run(src, "nomad_tpu/device/custom.py",
+                   ShardingSeamDiscipline) == []
+
+    def test_cache_partial_upload_is_exempt(self):
+        # per-shard incremental refresh must target one specific device;
+        # that IS the seam's partial-upload half
+        assert run(self.BAD, "nomad_tpu/device/cache.py",
+                   ShardingSeamDiscipline) == []
+
+    def test_backend_seam_is_out_of_scope(self):
+        assert run(self.BAD, "nomad_tpu/utils/backend.py",
+                   ShardingSeamDiscipline) == []
+
+    def test_device_and_scheduler_at_head_are_clean(self):
+        """The sharding refactor left zero bare placement sites: score,
+        flatten, algorithms, and hetero all route through shard_put."""
+        for rel in (
+            ("nomad_tpu", "device", "score.py"),
+            ("nomad_tpu", "device", "flatten.py"),
+            ("nomad_tpu", "scheduler", "algorithms.py"),
+            ("nomad_tpu", "scheduler", "hetero.py"),
+        ):
+            path = os.path.join(REPO_ROOT, *rel)
+            with open(path) as f:
+                src = f.read()
+            assert (
+                run(src, "/".join(rel), ShardingSeamDiscipline) == []
+            ), rel
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -810,7 +874,7 @@ class TestBaselineRatchet:
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
             "NTA007", "NTA008", "NTA009", "NTA010", "NTA011", "NTA012",
-            "NTA013", "NTA014",
+            "NTA013", "NTA014", "NTA015",
         ]
 
 
