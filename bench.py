@@ -982,6 +982,44 @@ def main():
         if not d["ok"]:
             sys.exit(1)
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "cp":
+        # constraint-programming dispatcher A/B: greedy binpack vs the
+        # cp-pack joint relaxation on one seeded contended mixed fleet.
+        # Canonical, seeded, byte-reproducible JSON; gates (exit 1) on
+        # cp-pack beating binpack on aggregate placement score OR
+        # preemptions avoided without regressing the other, and on the
+        # device kernel being byte-identical to its NumPy host oracle
+        # across two seeds (scheduler/cp.py).
+        fallback = _ensure_live_backend()
+        import jax
+
+        from nomad_tpu.scheduler.cp import run_cp_ab
+
+        n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+        n_jobs = int(sys.argv[3]) if len(sys.argv) > 3 else 12
+        count = int(sys.argv[4]) if len(sys.argv) > 4 else 40
+        d = run_cp_ab(
+            n_nodes=n_nodes, n_jobs=n_jobs, count_per_job=count, seed=42
+        )
+        d["mesh"] = mesh_block(n_nodes)
+        print(
+            json.dumps(
+                {
+                    "metric": "cp-pack aggregate score delta vs binpack "
+                    f"({n_nodes} nodes, {n_jobs} jobs x {count})",
+                    "value": d["ab"]["score_delta"],
+                    "unit": "score",
+                    "vs_baseline": 0.0,
+                    "platform": jax.devices()[0].platform,
+                    "fallback": fallback,
+                    "detail": d,
+                },
+                sort_keys=True,
+            )
+        )
+        if not d["ok"]:
+            sys.exit(1)
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "explain":
         # explain-seam overhead block: provenance-on must stay within
         # 5% of provenance-off at the config-3 inner shape (exit 1 on

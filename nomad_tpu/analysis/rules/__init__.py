@@ -17,6 +17,7 @@ from .admissiongate import AdmissionGateDiscipline  # noqa: E402
 from .algorithmseam import AlgorithmSeamDiscipline  # noqa: E402
 from .scoredump import ScoreDumpDiscipline  # noqa: E402
 from .shardingseam import ShardingSeamDiscipline  # noqa: E402
+from .solverseam import SolverSeamDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -34,6 +35,7 @@ REGISTRY = [
     AlgorithmSeamDiscipline,  # NTA013
     ScoreDumpDiscipline,  # NTA014
     ShardingSeamDiscipline,  # NTA015
+    SolverSeamDiscipline,  # NTA016
 ]
 
 __all__ = ["REGISTRY"]

@@ -16,9 +16,10 @@ Flagged: any call whose dotted leaf is ``PlacementKernel``,
 ``HeteroPlacementKernel``, or ``score_matrix_kernel`` inside
 ``nomad_tpu/scheduler/`` or ``nomad_tpu/server/``.
 
-Exempt: ``scheduler/algorithms.py`` (the registry IS the dispatcher)
-and ``scheduler/hetero.py`` (hetero kernels delegate to the base kernel
-internally). The device package itself (``nomad_tpu/device/``) is out
+Exempt: ``scheduler/algorithms.py`` (the registry IS the dispatcher),
+``scheduler/hetero.py``, and ``scheduler/cp.py`` (their kernels
+delegate to the base kernel internally, and cp.py's A/B harness
+benchmarks against it). The device package itself (``nomad_tpu/device/``) is out
 of scope — it defines the kernels and pins them against host oracles
 (device/parity.py); the rule polices *dispatch*, not implementation.
 """
@@ -33,6 +34,7 @@ _SCOPES = ("nomad_tpu/scheduler/", "nomad_tpu/server/")
 _EXEMPT = (
     "nomad_tpu/scheduler/algorithms.py",
     "nomad_tpu/scheduler/hetero.py",
+    "nomad_tpu/scheduler/cp.py",
 )
 
 _DISPATCH_LEAVES = (

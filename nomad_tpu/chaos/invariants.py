@@ -62,6 +62,12 @@ true no matter which faults fired:
     incremental refresh (dirty-region tracking) and the
     ``mesh.shard_refresh_drop`` chaos recovery path never leave a stale
     slice on any device (device/cache.py, utils/backend.py).
+``cp_assignment_conservation``
+    every group that entered a CP joint pass (scheduler/cp.py) ended
+    exactly one of placed / deferred / failed — the ``nomad.cp.*``
+    pass ledger balances — and no pass ever committed usage beyond a
+    node's capacity (``nomad.cp.capacity_violations`` stays 0), even
+    through ``cp.round_perturb`` price-perturbation windows.
 """
 
 from __future__ import annotations
@@ -88,6 +94,7 @@ INVARIANTS = (
     "admission_conservation",
     "class_capacity",
     "shard_consistency",
+    "cp_assignment_conservation",
 )
 
 
@@ -443,6 +450,36 @@ def check_cluster(
                 )
         report.info["admission"] = adm.snapshot()
 
+    # -- cp_assignment_conservation ----------------------------------------
+    # Law 13: the CP dispatcher's pass ledger must balance — every group
+    # submitted to a joint pass resolved as exactly one of placed,
+    # deferred, or failed — and no pass may ever have committed usage
+    # beyond capacity. Checked whenever any CP pass ran this process
+    # (counter-based, like law 10; perturbation windows included).
+    cp_counters = global_metrics.snapshot()["counters"]
+    cp_groups = cp_counters.get("nomad.cp.groups_in", 0)
+    if cp_groups:
+        report.checked["cp_assignment_conservation"] = True
+        resolved = (
+            cp_counters.get("nomad.cp.placed_groups", 0)
+            + cp_counters.get("nomad.cp.deferred_groups", 0)
+            + cp_counters.get("nomad.cp.failed_groups", 0)
+        )
+        if resolved != cp_groups:
+            report._fail(
+                "cp_assignment_conservation",
+                "cp_pass_ledger",
+                f"groups_in={cp_groups} != placed+deferred+failed="
+                f"{resolved}",
+            )
+        cp_viol = cp_counters.get("nomad.cp.capacity_violations", 0)
+        if cp_viol:
+            report._fail(
+                "cp_assignment_conservation",
+                "cp_capacity",
+                f"{cp_viol} node-rounds committed usage beyond capacity",
+            )
+
     # -- shard_consistency -------------------------------------------------
     # Law 12: with a multi-chip mesh active, the device-resident capacity
     # shards (per-shard incremental refresh, device/cache.py) re-gathered
@@ -471,7 +508,7 @@ def check_cluster(
         if k.startswith((
             "nomad.chaos.", "nomad.resilience.", "nomad.lane.",
             "nomad.overlay.", "nomad.plan.lane", "nomad.plan.cross_lane",
-            "nomad.admission.",
+            "nomad.admission.", "nomad.cp.",
         ))
         or k == "nomad.broker.nack_redelivery_delayed"
         or k.endswith(".swallowed_errors")

@@ -82,10 +82,17 @@ SITES: dict[str, tuple[str, ...]] = {
     # capacity upload — recovery must be a whole-tensor re-upload on
     # the same access, never a stale device shard (invariant law 12)
     "mesh.shard_refresh_drop": ("drop",),
+    # CP dispatcher (scheduler/cp.py): perturb the solver's initial
+    # prices for one joint pass — the assignment may legitimately shift,
+    # but conservation (invariant law 13) must hold: every group ends
+    # exactly one of placed/deferred/failed and capacity is never
+    # exceeded post-round
+    "cp.round_perturb": ("perturb",),
 }
 
 FAULT_KINDS = (
     "raise", "delay", "duplicate", "drop", "kill", "skew", "hang", "force",
+    "perturb",
 )
 
 # Expected effective-call budget per site for a `steps`-op workload,
@@ -111,6 +118,8 @@ _HORIZON = {
     "admission.flap": (0.5, 4),
     # hit per cache device-view access with dirty regions pending
     "mesh.shard_refresh_drop": (0.125, 2),
+    # hit once per joint CP placement pass, not per workload op
+    "cp.round_perturb": (0.125, 2),
 }
 
 
@@ -294,7 +303,8 @@ class FaultPlane:
             with self._lock:
                 self.kills += 1
             raise ChaosThreadKill(site, n)
-        # "drop" / "duplicate" / "force": the site decides what it means
+        # "drop" / "duplicate" / "force" / "perturb": the site decides
+        # what it means
         return action
 
     def ledger_commit(self, alloc_ids) -> None:

@@ -1,0 +1,282 @@
+"""Batched joint placement as an assignment relaxation solved on device.
+
+The CP/ILP job-dispatcher line (PAPERS.md: arxiv 2009.10348, constraint-
+based pod packing arxiv 2511.08373) models dispatch as one assignment
+problem: variables = (group-slot × node), constraints = per-node
+capacity over every resource dim, distinct_hosts, cross-group coupling,
+priority tiers. This module is that formulation over the dense score
+matrix (device/score.py finals), solved by **iterated proportional
+rounding** — an auction-flavored price loop:
+
+  1. price the matrix: ``u[g, n] = score[g, n] − λ[n] − anti·sib[g, n]``
+     (λ = per-node congestion price, sib = OTHER same-job groups'
+     instances already rounded onto the node this pass — the in-batch
+     anti-affinity coupling the per-group kernels cannot see; a group's
+     own instances are priced only by λ and blocked only by
+     distinct_hosts, so piling a group on its best node stays free);
+  2. every unfinished group claims its argmax-feasible node (the
+     proportional assignment, rounded to its most-confident row);
+  3. each contested node admits ONE claimant — highest priority tier
+     first, then highest priced utility (first index on ties) — and
+     commits exactly one instance, so per-node capacity is re-checked
+     against the committed ``used`` and can never be exceeded;
+  4. λ rises on every node with leftover claimants (the capacity-
+     violation price update of the relaxation: demand beyond the one
+     slot a node can absorb per round) and RELAXES on nodes nobody
+     claims — congestion pricing, not a ratchet, so a node priced up
+     during an early contested phase recovers once demand moves on —
+     and the loop repeats until a round commits nothing.
+
+Up to min(G, N) instances commit per round, against the slot-at-a-time
+greedy kernels' one — the same generalization device/preempt.py made
+for victim selection, now for whole-batch placement.
+
+Byte-parity discipline (scheduler/hetero.py's contract): the jitted
+kernel (``lax.while_loop``) and the NumPy host oracle share one round's
+math through the ``_cp_*`` helpers; every carried value is f32/i32,
+every op is elementwise/argmax/integer-sum (no transcendentals, no
+float reductions — XLA's ``exp`` and sum orders are not bitwise
+NumPy's, so prices update from exact integer claim counts scaled by a
+power of two), and ties break on the first index in both argmax
+implementations. The parity tests compare uint32 views.
+
+Only ``scheduler/cp.py`` and the algorithm registry may call into this
+module — lint rule NTA016 (SolverSeamDiscipline).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..utils.backend import traced_jit
+
+import jax
+import jax.numpy as jnp
+
+# Price step per leftover claimant: a power of two, so the f32 multiply
+# is exact and host/device prices agree bitwise.
+ETA = np.float32(0.125)
+# In-batch same-job co-location penalty (soft anti-affinity across task
+# groups of one job). Also a power of two for exact f32 scaling.
+ANTI = np.float32(0.0625)
+
+_NEG_INF = np.float32(-np.inf)
+
+
+def _steps_bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+# -- shared round math (np and jnp, identical op order) ----------------------
+
+
+def _cp_feasible(capacity, used, asks, eligible, job_counts, assigned_sib,
+                 distinct):
+    """bool[G, N]: capacity room for one more instance ∧ eligible ∧
+    distinct_hosts honored against existing allocs AND same-job
+    instances rounded earlier in this pass."""
+    xp = np if isinstance(capacity, np.ndarray) else jnp
+    proposed = used[None, :, :] + asks[:, None, :]  # [G, N, D]
+    fits = xp.all(proposed <= capacity[None, :, :], axis=-1)
+    taken = (job_counts + assigned_sib) > 0
+    return fits & eligible & ~(distinct[:, None] & taken)
+
+
+def _cp_siblings(jobgrp, assigned):
+    """Two i32[G, N] views of same-job commits this pass (integer matmul
+    — exact and order-free): ``sib_all`` counts every same-job instance
+    (what distinct_hosts must honor), ``sib_other`` excludes the group's
+    own instances (what the anti-affinity price charges — a group never
+    repels itself off its best node)."""
+    xp = np if isinstance(assigned, np.ndarray) else jnp
+    same = (jobgrp[:, None] == jobgrp[None, :]).astype(xp.int32)
+    sib_all = same @ assigned
+    return sib_all, sib_all - assigned
+
+
+def _cp_priced(scores, lam, sib):
+    """f32[G, N] priced utilities (all elementwise — bitwise portable)."""
+    xp = np if isinstance(scores, np.ndarray) else jnp
+    return scores - lam[None, :] - ANTI * sib.astype(xp.float32)
+
+
+def _cp_winners(umask, feas, active, prio, arange_g, arange_n):
+    """One auction round's selection. Every unfinished group claims its
+    argmax feasible node; each claimed node admits the claimant with the
+    highest (priority, priced utility) — lexicographic via two masked
+    maxes, no magnitude mixing. Returns (claim i32[G], claimable bool[G],
+    won bool[G], win i32[N], has bool[N], claims i32[N])."""
+    xp = np if isinstance(prio, np.ndarray) else jnp
+    claim = xp.argmax(umask, axis=1).astype(xp.int32)
+    claimable = active & xp.any(feas, axis=1)
+    claim_m = claimable[:, None] & (claim[:, None] == arange_n[None, :])
+    neg = xp.float32(_NEG_INF)
+    prio_m = xp.where(claim_m, prio[:, None], neg)
+    maxprio = prio_m.max(axis=0)  # f32[N]
+    uclaim = umask[arange_g, claim]  # f32[G], finite where claimable
+    conf_ok = claim_m & (prio[:, None] == maxprio[None, :])
+    conf_m = xp.where(conf_ok, uclaim[:, None], neg)
+    win = xp.argmax(conf_m, axis=0).astype(xp.int32)
+    has = xp.any(claim_m, axis=0)
+    won = claimable & has[claim] & (win[claim] == arange_g)
+    claims = claim_m.astype(xp.int32).sum(axis=0)  # exact integer sum
+    return claim, claimable, won, win, has, claims
+
+
+@functools.partial(
+    traced_jit, retrace_budget=16, static_argnames=("steps", "max_c")
+)
+def cp_place_kernel(
+    capacity,  # f32[N, D]
+    used0,  # f32[N, D]
+    asks,  # f32[G, D]
+    counts,  # i32[G]
+    eligible,  # bool[G, N]
+    scores,  # f32[G, N] dense score matrix (registry score_group finals)
+    prio,  # f32[G] job priority (exact small ints)
+    job_counts,  # i32[G, N] existing same-job allocs per node
+    distinct,  # bool[G] distinct_hosts groups
+    jobgrp,  # i32[G] job grouping codes (same job → same code)
+    lam0,  # f32[N] initial prices (zeros; chaos perturbs)
+    steps: int,
+    max_c: int,
+):
+    """Iterated proportional rounding on device. Returns (choices
+    i32[G, C], choice_scores f32[G, C], used f32[N, D], rounds i32,
+    lam f32[N]) — C = max_c, -1 = unfilled, rounds = committing rounds."""
+    g, n = scores.shape
+    arange_g = jnp.arange(g)
+    arange_n = jnp.arange(n)
+
+    def cond(carry):
+        it, progress = carry[0], carry[1]
+        return (it < steps) & progress
+
+    def body(carry):
+        it, _, rounds, used, placed, assigned, choices, choice_scores, lam \
+            = carry
+        sib_all, sib_other = _cp_siblings(jobgrp, assigned)
+        feas = _cp_feasible(
+            capacity, used, asks, eligible, job_counts, sib_all, distinct
+        )
+        active = placed < counts
+        umask = jnp.where(
+            feas, _cp_priced(scores, lam, sib_other), _NEG_INF
+        )
+        claim, claimable, won, win, has, claims = _cp_winners(
+            umask, feas, active, prio, arange_g, arange_n
+        )
+        # commit: ≤1 instance per group (its claim) and ≤1 per node (the
+        # winner) per round — injective both ways, so the single-instance
+        # fit check in `feas` is exactly the capacity invariant
+        delta = jnp.where(has[:, None], asks[win], jnp.float32(0.0))
+        used = used + delta
+        slot = jnp.minimum(placed, max_c - 1)
+        old_c = choices[arange_g, slot]
+        old_s = choice_scores[arange_g, slot]
+        choices = choices.at[arange_g, slot].set(
+            jnp.where(won, claim, old_c)
+        )
+        choice_scores = choice_scores.at[arange_g, slot].set(
+            jnp.where(won, scores[arange_g, claim], old_s)
+        )
+        onehot = (won[:, None] & (claim[:, None] == arange_n[None, :]))
+        assigned = assigned + onehot.astype(jnp.int32)
+        placed = placed + won.astype(jnp.int32)
+        # capacity-violation price update: demand beyond the one slot a
+        # node absorbed this round (exact integer count × power of two);
+        # unclaimed nodes decay back toward 0 so stale congestion never
+        # permanently repels demand from a node with room
+        lam = lam + ETA * jnp.maximum(claims - 1, 0).astype(jnp.float32)
+        lam = jnp.where(
+            claims == 0, jnp.maximum(lam - ETA, jnp.float32(0.0)), lam
+        )
+        progress = jnp.any(claimable)
+        rounds = rounds + progress.astype(jnp.int32)
+        return (it + 1, progress, rounds, used, placed, assigned,
+                choices, choice_scores, lam)
+
+    carry = (
+        jnp.int32(0),
+        jnp.bool_(True),
+        jnp.int32(0),
+        used0,
+        jnp.zeros(g, dtype=jnp.int32),
+        jnp.zeros((g, n), dtype=jnp.int32),
+        jnp.full((g, max_c), -1, dtype=jnp.int32),
+        jnp.zeros((g, max_c), dtype=jnp.float32),
+        lam0,
+    )
+    out = jax.lax.while_loop(cond, body, carry)
+    _, _, rounds, used, _, _, choices, choice_scores, lam = out
+    return choices, choice_scores, used, rounds, lam
+
+
+def oracle_cp_place(
+    capacity: np.ndarray,
+    used0: np.ndarray,
+    asks: np.ndarray,
+    counts: np.ndarray,
+    eligible: np.ndarray,
+    scores: np.ndarray,
+    prio: np.ndarray,
+    job_counts: np.ndarray,
+    distinct: np.ndarray,
+    jobgrp: np.ndarray,
+    lam0: np.ndarray,
+    steps: int,
+    max_c: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int, np.ndarray]:
+    """Pure-NumPy host oracle: the same round math as the device kernel,
+    stepwise. Byte-identical output is the contract (tests/test_cp.py
+    pins uint32 views across seeds, like hetero's oracle)."""
+    g, n = scores.shape
+    arange_g = np.arange(g)
+    arange_n = np.arange(n)
+    used = used0.astype(np.float32).copy()
+    placed = np.zeros(g, dtype=np.int32)
+    assigned = np.zeros((g, n), dtype=np.int32)
+    choices = np.full((g, max_c), -1, dtype=np.int32)
+    choice_scores = np.zeros((g, max_c), dtype=np.float32)
+    lam = lam0.astype(np.float32).copy()
+    counts = counts.astype(np.int32)
+    it = 0
+    rounds = 0
+    progress = True
+    while it < steps and progress:
+        sib_all, sib_other = _cp_siblings(jobgrp, assigned)
+        feas = _cp_feasible(
+            capacity, used, asks, eligible, job_counts, sib_all, distinct
+        )
+        active = placed < counts
+        umask = np.where(
+            feas, _cp_priced(scores, lam, sib_other), _NEG_INF
+        )
+        claim, claimable, won, win, has, claims = _cp_winners(
+            umask, feas, active, prio, arange_g, arange_n
+        )
+        delta = np.where(has[:, None], asks[win], np.float32(0.0))
+        used = used + delta
+        slot = np.minimum(placed, max_c - 1)
+        old_c = choices[arange_g, slot]
+        old_s = choice_scores[arange_g, slot]
+        choices[arange_g, slot] = np.where(won, claim, old_c)
+        choice_scores[arange_g, slot] = np.where(
+            won, scores[arange_g, claim], old_s
+        )
+        onehot = won[:, None] & (claim[:, None] == arange_n[None, :])
+        assigned = assigned + onehot.astype(np.int32)
+        placed = placed + won.astype(np.int32)
+        lam = lam + ETA * np.maximum(claims - 1, 0).astype(np.float32)
+        lam = np.where(
+            claims == 0, np.maximum(lam - ETA, np.float32(0.0)), lam
+        )
+        progress = bool(claimable.any())
+        rounds += int(progress)
+        it += 1
+    return choices, choice_scores, used, rounds, lam

@@ -340,6 +340,10 @@ class GroupAsk:
     # binpack/spread kernels never read it at all (bit-identity).
     throughputs: np.ndarray | None = None  # f32[N]
     has_throughputs: bool = False
+    # Job priority (structs/job.py, 0-100). The CP dispatcher's joint
+    # pass resolves contested nodes by tier before score (scheduler/
+    # cp.py); the per-group kernels never read it.
+    priority: int = 50
 
     @property
     def has_spreads(self) -> bool:
@@ -829,4 +833,5 @@ def flatten_group_ask(
         filter_stats=filter_stats,
         throughputs=throughputs,
         has_throughputs=has_tp,
+        priority=job.priority,
     )

@@ -96,6 +96,11 @@ class PlacementExplanation:
     rejections: dict[str, int] = field(default_factory=dict)
     # committed node ids in placement order (post conflict repair)
     placed_nodes: list[str] = field(default_factory=list)
+    # CP solver provenance when the cp-pack joint pass scored
+    # (scheduler/cp.py): {"iterations", "gap", "agreement"}. None for
+    # every other algorithm — the JSON shape only grows a "cp" block
+    # when the solver ran, so existing schema pins are untouched.
+    cp: dict | None = None
 
 
 def _feasibility(capacity, used, a, n: int, throughputs=None):
@@ -421,6 +426,54 @@ def explain_hetero_group(
     return ex
 
 
+def explain_cp_group(
+    cluster,
+    a,
+    used0,
+    *,
+    scores_row,
+    cp: dict | None = None,
+    top_k: int = DEFAULT_TOP_K,
+) -> PlacementExplanation:
+    """Explanation for one group of the joint CP pass (scheduler/cp.py).
+    Candidates rank by the group's dense score row — the relaxation's
+    objective coefficients, i.e. the node the fractional assignment
+    weights highest comes first — and the solver-level provenance
+    (iterations, duality-gap proxy, rounded-vs-fractional agreement)
+    rides in the ``cp`` block. Stays on the non-hetero finalize path
+    (``policy`` empty): per-instance breakdowns replay the same binpack
+    component math the score row was built from."""
+    n = cluster.num_nodes
+    capacity = np.asarray(cluster.capacity)
+    used = np.asarray(used0)
+    fits, rejections = _feasibility(capacity, used, a, n)
+    ex = PlacementExplanation(
+        job_id=a.job_id,
+        tg_name=a.tg_name,
+        algorithm="cp-pack",
+        nodes_evaluated=n,
+        feasible_nodes=int(fits.sum()),
+        rejections=rejections,
+        cp=dict(cp) if cp is not None else None,
+    )
+    if not fits.any() or a.count <= 0:
+        return ex
+    key = np.where(fits, np.asarray(scores_row[:n], dtype=np.float64),
+                   -np.inf)
+    order = np.argsort(-key, kind="stable")[: max(top_k, 1)]
+    order = order[key[order] > -np.inf]
+    for r in order:
+        ex.top_candidates.append(
+            CandidateExplanation(
+                node_id=cluster.node_ids[int(r)],
+                node_row=int(r),
+                final_score=float(key[r]),
+                components={"score-matrix": float(key[r])},
+            )
+        )
+    return ex
+
+
 def _instance_components_vec(capacity, used0, a, rows, mine, algorithm_spread):
     """Vectorized per-instance breakdowns for one lane's committed rows —
     the blocks-free fast path of the finalize replay. Instance i on row
@@ -639,4 +692,5 @@ def explanation_to_dict(ex: PlacementExplanation) -> dict:
         ],
         "rejections": dict(ex.rejections),
         "placed_nodes": list(ex.placed_nodes),
+        **({"cp": dict(ex.cp)} if ex.cp is not None else {}),
     }

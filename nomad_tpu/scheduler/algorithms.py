@@ -141,6 +141,20 @@ class HeteroCostAlgorithm(_HeteroAlgorithm):
     description = "maximize throughput per device-class cost"
 
 
+@register_algorithm
+class CpPackAlgorithm(SchedulerAlgorithm):
+    name = "cp-pack"
+    description = (
+        "whole-batch joint placement: assignment relaxation over the "
+        "score matrix, solved on device by iterated proportional rounding"
+    )
+
+    def make_kernel(self, force_scan: bool = False, mesh=None):
+        from .cp import CpPlacementKernel
+
+        return CpPlacementKernel(force_scan, mesh=mesh)
+
+
 # -- registry-routed score matrix -------------------------------------------
 
 

@@ -1,0 +1,457 @@
+"""Constraint-programming dispatcher: whole-batch joint placement.
+
+The ``cp-pack`` algorithm plugin (scheduler/algorithms.py). One pass
+takes EVERY pending group at once, assembles the dense score matrix
+through the registry's ``score_group`` seam (the same finals binpack
+ranks by), and hands the whole batch to ``device/cp.py``'s iterated
+proportional rounding kernel — an auction-style relaxation where
+congestion prices mediate contention instead of per-group greedy order:
+
+- per-node capacity across all resource dims is exact by construction
+  (one instance per node per round, fit-checked against committed use);
+- ``distinct_hosts`` holds against existing allocs AND instances rounded
+  earlier in the same pass;
+- same-job groups repel each other through an in-batch anti-affinity
+  price (the cross-task-group coupling per-group kernels cannot see);
+- priority tiers win contested nodes before any score comparison.
+
+What the relaxation does not model — spread/distinct_property value
+blocks and device slot caps — delegates the whole batch to the base
+binpack kernel, exactly like scheduler/hetero.py's gate, so those
+features keep their battle-tested path. A tripped ``cp_place_kernel``
+circuit breaker (resilience/breaker.py) also falls back to greedy
+binpack for the pass (``nomad.cp.fallback_passes``).
+
+Conservation accounting for chaos invariant law 13
+(``cp_assignment_conservation``): every group in a CP pass ends exactly
+one of placed / deferred / failed, and committed usage never exceeds
+capacity (``nomad.cp.*`` counters). Chaos site ``cp.round_perturb``
+perturbs the solver's initial prices — the solution may legitimately
+shift, but law 13 must still hold.
+
+``run_cp_ab`` is the ``bench.py cp`` acceptance harness: binpack vs
+cp-pack on the seeded 1k-node mixed fleet, device kernel cross-checked
+byte-identical against the NumPy oracle, canonical byte-reproducible
+report.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..device.cp import (
+    _steps_bucket,
+    cp_place_kernel,
+    oracle_cp_place,
+)
+
+#: per-node initial-price perturbation applied when chaos fires
+#: ``cp.round_perturb``: exact f32 (power-of-two scale, small ints) so a
+#: perturbed run is still byte-deterministic for its schedule.
+PERTURB_SCALE = np.float32(0.0625)
+
+
+@dataclass
+class CpBatch:
+    """Assembled dense inputs for one joint CP pass."""
+
+    capacity: np.ndarray
+    used: np.ndarray
+    asks: np.ndarray
+    counts: np.ndarray
+    eligible: np.ndarray
+    scores: np.ndarray
+    prio: np.ndarray
+    job_counts: np.ndarray
+    distinct: np.ndarray
+    jobgrp: np.ndarray
+    lam0: np.ndarray
+    steps: int
+    max_c: int
+
+
+def perturb_prices(pn: int) -> np.ndarray:
+    """Deterministic non-uniform initial-price vector for the
+    ``cp.round_perturb`` chaos action (zeros would be a no-op: a
+    uniform shift cancels inside every argmax)."""
+    return (PERTURB_SCALE * (np.arange(pn) % 8)).astype(np.float32)
+
+
+def build_cp_batch(cluster, asks: list, used_override=None,
+                   lam0=None) -> CpBatch:
+    """Score rows come from the registry's ``score_group`` seam — the
+    identical finals binpack ranks by, so the A/B compares solvers, not
+    scoring functions. Scoring runs against the cluster's base usage
+    snapshot (like the base kernel's batch pass); feasibility inside the
+    solver is exact against ``used_override`` + committed rounds."""
+    from .algorithms import score_group
+
+    pn = cluster.padded_n
+    g = len(asks)
+    ask_m = np.stack([a.ask for a in asks]).astype(np.float32)
+    counts = np.array([a.count for a in asks], dtype=np.int32)
+    eligible = np.stack([a.eligible for a in asks]).copy()
+    scores = np.zeros((g, pn), dtype=np.float32)
+    for i, a in enumerate(asks):
+        finals, fits = score_group(cluster, a, float(a.desired_total))
+        scores[i] = np.where(fits, finals, np.float32(0.0))
+        eligible[i] &= fits
+    prio = np.array(
+        [float(getattr(a, "priority", 50)) for a in asks], dtype=np.float32
+    )
+    job_counts = np.stack([a.job_counts for a in asks]).astype(np.int32)
+    distinct = np.array([a.distinct_hosts for a in asks], dtype=bool)
+    codes: dict[str, int] = {}
+    jobgrp = np.array(
+        [codes.setdefault(a.job_id, len(codes)) for a in asks],
+        dtype=np.int32,
+    )
+    used = (
+        used_override if used_override is not None else cluster.used
+    ).astype(np.float32)
+    if lam0 is None:
+        lam0 = np.zeros(pn, dtype=np.float32)
+    total = int(counts.sum())
+    return CpBatch(
+        capacity=cluster.capacity.astype(np.float32),
+        used=used,
+        asks=ask_m,
+        counts=counts,
+        eligible=eligible,
+        scores=scores,
+        prio=prio,
+        job_counts=job_counts,
+        distinct=distinct,
+        jobgrp=jobgrp,
+        lam0=lam0.astype(np.float32),
+        steps=_steps_bucket(total + 1),
+        max_c=_steps_bucket(max(int(counts.max(initial=1)), 1)),
+    )
+
+
+def solver_stats(batch: CpBatch, choices: np.ndarray,
+                 choice_scores: np.ndarray, rounds: int) -> dict:
+    """Host-side solver provenance (one implementation — computed from
+    the kernel's outputs, so device and oracle paths agree by
+    construction):
+
+    - ``gap``: duality-gap proxy = fractional upper bound (each group's
+      count best eligible rows, per-node capacity relaxed) − the rounded
+      objective;
+    - ``agreement``: fraction of committed slots that landed inside
+      their group's fractional-optimum row set (rounding confidence)."""
+    masked = np.where(batch.eligible, batch.scores, -np.inf)  # f32[G, N]
+    committed = choices >= 0
+    achieved = float(choice_scores[committed].astype(np.float64).sum())
+    bound = 0.0
+    in_opt = 0
+    for i, c in enumerate(batch.counts):
+        order = np.argsort(-masked[i], kind="stable")[: int(c)]
+        top = masked[i, order]
+        top = top[np.isfinite(top)]
+        bound += float(top.astype(np.float64).sum())
+        opt_rows = set(order[: top.size].tolist())
+        rows = choices[i][committed[i]]
+        in_opt += sum(int(r) in opt_rows for r in rows)
+    n_placed = int(committed.sum())
+    return {
+        "iterations": int(rounds),
+        "gap": round(max(bound - achieved, 0.0), 6),
+        "agreement": round(in_opt / n_placed, 6) if n_placed else 1.0,
+    }
+
+
+class CpPlacementKernel:
+    """Drop-in for device/score.py's PlacementKernel behind the
+    algorithm registry: one joint CP pass per batch; blocks/slot-caps
+    batches and breaker-tripped passes delegate to greedy binpack."""
+
+    def __init__(self, force_scan: bool = False, mesh=None):
+        from ..device.score import PlacementKernel
+
+        self.algorithm_spread = False
+        self.force_scan = force_scan
+        self._mesh = mesh
+        self._base = PlacementKernel("binpack", force_scan, mesh=mesh)
+
+    def mesh_cfg(self):
+        from ..utils.backend import get_mesh
+
+        return self._mesh if self._mesh is not None else get_mesh()
+
+    def _cp_eligible(self, asks: list) -> bool:
+        # value blocks (spread / distinct_property) and device slot caps
+        # are not modeled by the relaxation — battle-tested base scan
+        return not any(
+            a.blocks is not None or a.slot_caps is not None for a in asks
+        )
+
+    def _fallback_open(self) -> bool:
+        from ..resilience.breaker import CLOSED, breaker_for, forced_open
+
+        if forced_open():
+            return True
+        return breaker_for("cp_place_kernel").state != CLOSED
+
+    def place(self, cluster, asks: list, **kwargs):
+        from ..device.score import PlacementResult
+        from ..utils.metrics import global_metrics
+
+        if not asks:
+            return []
+        if self._fallback_open():
+            global_metrics.incr("nomad.cp.fallback_passes")
+            return self._base.place(cluster, asks, **kwargs)
+        if not self._cp_eligible(asks):
+            return self._base.place(cluster, asks, **kwargs)
+
+        from ..chaos.plane import chaos_site
+
+        lam0 = None
+        if chaos_site("cp.round_perturb") == "perturb":
+            lam0 = perturb_prices(cluster.padded_n)
+            global_metrics.incr("nomad.cp.chaos_perturbs")
+        batch = build_cp_batch(
+            cluster, asks,
+            used_override=kwargs.get("used_override"),
+            lam0=lam0,
+        )
+        from ..utils.backend import shard_put
+
+        cfg = self.mesh_cfg()
+        choices, choice_scores, used, rounds, _lam = cp_place_kernel(
+            shard_put(batch.capacity, ("nodes",), cfg),
+            shard_put(batch.used, ("nodes",), cfg),
+            shard_put(batch.asks, ("groups",), cfg),
+            shard_put(batch.counts, ("groups",), cfg),
+            shard_put(batch.eligible, ("groups", "nodes"), cfg),
+            shard_put(batch.scores, ("groups", "nodes"), cfg),
+            shard_put(batch.prio, ("groups",), cfg),
+            shard_put(batch.job_counts, ("groups", "nodes"), cfg),
+            shard_put(batch.distinct, ("groups",), cfg),
+            batch.jobgrp,
+            batch.lam0,
+            steps=batch.steps,
+            max_c=batch.max_c,
+        )
+        choices = np.asarray(choices)
+        choice_scores = np.asarray(choice_scores)
+        used_out = np.asarray(used)
+
+        # law 13 (cp_assignment_conservation) accounting
+        g = len(asks)
+        placed_g = deferred_g = failed_g = 0
+        for i, a in enumerate(asks):
+            k = int((choices[i, : a.count] >= 0).sum())
+            if k >= a.count:
+                placed_g += 1
+            elif k > 0:
+                deferred_g += 1
+            else:
+                failed_g += 1
+        violations = int((used_out > batch.capacity).any(axis=1).sum())
+        global_metrics.incr("nomad.cp.groups_in", g)
+        global_metrics.incr("nomad.cp.placed_groups", placed_g)
+        global_metrics.incr("nomad.cp.deferred_groups", deferred_g)
+        global_metrics.incr("nomad.cp.failed_groups", failed_g)
+        if violations:
+            global_metrics.incr("nomad.cp.capacity_violations", violations)
+
+        explain = bool(kwargs.get("explain", False))
+        stats = (
+            solver_stats(batch, choices, choice_scores, int(rounds))
+            if explain
+            else None
+        )
+        results = []
+        for i, a in enumerate(asks):
+            rows = choices[i, : a.count].astype(np.int32)
+            scores_row = np.where(
+                rows >= 0,
+                choice_scores[i, : a.count],
+                np.float32(-np.inf),
+            ).astype(np.float32)
+            res = PlacementResult(node_rows=rows, scores=scores_row)
+            if explain:
+                # same Python-level gate as the base/hetero kernels:
+                # explain-off traces and places exactly as before
+                from ..obs.explain import explain_cp_group
+
+                res.explanation = explain_cp_group(
+                    cluster, a, batch.used,
+                    scores_row=batch.scores[i],
+                    cp=stats,
+                )
+            results.append(res)
+        return results
+
+
+# -- seeded A/B harness (bench.py cp) ----------------------------------------
+
+
+def build_cp_asks(ct, n_jobs: int, count_per_job: int, seed: int = 7):
+    """Contended CP workload on the mixed fleet: the hetero profile asks
+    scaled up so top-ranked nodes hold only a few instances, every 4th
+    job demanding distinct hosts, and three priority tiers — the
+    co-placement regime where greedy order matters and the joint
+    relaxation has room to win."""
+    from .hetero import build_mixed_asks
+
+    asks = build_mixed_asks(ct, n_jobs, count_per_job, seed=seed)
+    for j, a in enumerate(asks):
+        a.ask = (a.ask * np.float32(4.0)).astype(np.float32)
+        a.priority = (30, 50, 80)[j % 3]
+        if j % 4 == 3:
+            a.distinct_hosts = True
+    return asks
+
+
+def _cp_quality(asks, results, scores: np.ndarray) -> dict:
+    """Canonical quality block for one algorithm's output: slots placed,
+    slots left unplaced (preemption pressure), and the assignment's
+    value under ONE shared objective — the dense score matrix both
+    solvers rank by. Kernels report per-slot scores on their own
+    internal scales (binpack re-scores against evolving usage), so the
+    like-for-like A/B re-values both assignments under the matrix."""
+    placed = 0
+    unplaced = 0
+    aggregate = 0.0
+    for i, (a, r) in enumerate(zip(asks, results)):
+        rows = np.asarray(r.node_rows)
+        ok = rows >= 0
+        placed += int(ok.sum())
+        unplaced += int(a.count - ok.sum())
+        aggregate += float(scores[i, rows[ok]].astype(np.float64).sum())
+    return {
+        "placed": placed,
+        "unplaced": unplaced,
+        "aggregate_score": round(aggregate, 4),
+    }
+
+
+def run_cp_ab(
+    n_nodes: int = 1000,
+    n_jobs: int = 12,
+    count_per_job: int = 40,
+    seed: int = 42,
+) -> dict:
+    """The ``bench.py cp`` A/B block: greedy binpack vs cp-pack on one
+    seeded contended mixed fleet. Placements are deterministic for a
+    seed, so the whole report is byte-reproducible. The device kernel is
+    cross-checked byte-identical against the NumPy host oracle on two
+    seeds (uint32 views)."""
+    from ..device.score import PlacementKernel
+    from .hetero import build_mixed_fleet
+
+    ct = build_mixed_fleet(n_nodes, seed=seed)
+    asks = build_cp_asks(ct, n_jobs, count_per_job, seed=seed + 1)
+
+    base = PlacementKernel("binpack")
+    base_results = base.place(ct, asks)
+    kern = CpPlacementKernel()
+    cp_results = kern.place(ct, asks)
+
+    mismatches = 0
+    stats = {}
+    for check_seed in (seed, seed + 1):
+        ct2 = build_mixed_fleet(n_nodes, seed=check_seed)
+        asks2 = build_cp_asks(ct2, n_jobs, count_per_job, seed=check_seed + 1)
+        batch = build_cp_batch(ct2, asks2)
+        d = cp_place_kernel(
+            batch.capacity, batch.used, batch.asks, batch.counts,
+            batch.eligible, batch.scores, batch.prio, batch.job_counts,
+            batch.distinct, batch.jobgrp, batch.lam0,
+            steps=batch.steps, max_c=batch.max_c,
+        )
+        o = oracle_cp_place(
+            batch.capacity, batch.used, batch.asks, batch.counts,
+            batch.eligible, batch.scores, batch.prio, batch.job_counts,
+            batch.distinct, batch.jobgrp, batch.lam0,
+            batch.steps, batch.max_c,
+        )
+        d_choices, d_scores, d_used = (
+            np.asarray(d[0]), np.asarray(d[1]), np.asarray(d[2])
+        )
+        mismatches += int(
+            (d_choices != o[0]).sum()
+            + (d_scores.view(np.uint32) != o[1].view(np.uint32)).sum()
+            + (d_used.view(np.uint32) != o[2].view(np.uint32)).sum()
+            + (int(np.asarray(d[3])) != o[3])
+        )
+        if check_seed == seed:
+            stats = solver_stats(batch, d_choices, d_scores,
+                                 int(np.asarray(d[3])))
+
+    value_batch = build_cp_batch(ct, asks)
+    b = _cp_quality(asks, base_results, value_batch.scores)
+    c = _cp_quality(asks, cp_results, value_batch.scores)
+    score_delta = round(c["aggregate_score"] - b["aggregate_score"], 4)
+    preempt_avoided = b["unplaced"] - c["unplaced"]
+    report = {
+        "config": {
+            "nodes": n_nodes,
+            "jobs": n_jobs,
+            "count_per_job": count_per_job,
+            "seed": seed,
+            "device_classes": sorted(
+                k for k in ct.device_class_vocab if k
+            ),
+        },
+        "binpack": b,
+        "cp": {**c, "solver": stats},
+        "oracle_mismatches": mismatches,
+        "ab": {
+            "score_delta": score_delta,
+            "preemptions_avoided": preempt_avoided,
+            "cp_beats_score": score_delta > 0,
+            "cp_avoids_preemptions": preempt_avoided > 0,
+        },
+    }
+    ab = report["ab"]
+    report["ok"] = mismatches == 0 and (
+        (ab["cp_beats_score"] and preempt_avoided >= 0)
+        or (ab["cp_avoids_preemptions"] and score_delta >= 0)
+    )
+    return report
+
+
+CP_SCHEMA = (
+    "ab.cp_avoids_preemptions",
+    "ab.cp_beats_score",
+    "ab.preemptions_avoided",
+    "ab.score_delta",
+    "binpack.aggregate_score",
+    "binpack.placed",
+    "binpack.unplaced",
+    "config.count_per_job",
+    "config.device_classes",
+    "config.jobs",
+    "config.nodes",
+    "config.seed",
+    "cp.aggregate_score",
+    "cp.placed",
+    "cp.solver.agreement",
+    "cp.solver.gap",
+    "cp.solver.iterations",
+    "cp.unplaced",
+    "ok",
+    "oracle_mismatches",
+)
+
+
+def cp_schema_of(report: dict) -> tuple[str, ...]:
+    """Sorted dotted key paths of a run_cp_ab report (lists are leaves),
+    pinned against CP_SCHEMA by the tier-1 smoke test."""
+    paths: list[str] = []
+
+    def walk(prefix: str, obj) -> None:
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(f"{prefix}.{k}" if prefix else str(k), v)
+        else:
+            paths.append(prefix)
+
+    walk("", report)
+    return tuple(sorted(paths))

@@ -92,6 +92,28 @@ EXTERNAL_TRIGGERS = frozenset(
 )
 
 
+def job_cost_demand(job, costs: Optional[dict] = None) -> float:
+    """Device-class-cost-weighted demand of one job: Σ over task groups
+    of ``count × cpu-cores``, scaled by the costliest device class the
+    job targets (``throughputs`` keys) under scheduler/hetero.py's
+    ``DEVICE_CLASS_COSTS`` — the same table ``class_cost_vector``
+    reads, so admission's notion of "expensive" matches the scheduler's.
+    A throughput-agnostic job runs on anything and is costed at the
+    baseline 1.0."""
+    if costs is None:
+        from ..scheduler.hetero import DEVICE_CLASS_COSTS
+
+        costs = DEVICE_CLASS_COSTS
+    weight = 1.0
+    for cls in getattr(job, "throughputs", {}) or {}:
+        weight = max(weight, float(costs.get(cls, 1.0)))
+    cores = 0.0
+    for tg in getattr(job, "task_groups", []) or []:
+        group_cpu = sum(t.resources.cpu for t in tg.tasks)
+        cores += max(tg.count, 0) * group_cpu / 1000.0
+    return weight * cores
+
+
 def tier_of(priority: int) -> str:
     """Priority → tier. Matches the repo's conventional 30/50/70 split:
     >=70 high, 40–69 normal, <40 low."""
@@ -214,6 +236,10 @@ _DEFAULTS: dict = {
     # thrashing small kernel passes
     "brownout_batch_factor": 2,
     "brownout_batch_timeout_s": 0.4,
+    # cost-aware shed ordering within the low tier: submissions at or
+    # below this quantile of recently-seen cost demands defer instead of
+    # shedding, so the expensive half of the tier sheds first
+    "shed_cost_quantile": 0.5,
 }
 
 _LEVEL_GAUGE = "nomad.admission.level"
@@ -271,6 +297,9 @@ class AdmissionController:
             for tier in TIERS
         }
         self._exempt = 0
+        # cost profile of low-tier submissions (law-10-neutral: it only
+        # reorders WHICH low-tier jobs shed, never how many decisions)
+        self._cost_hist = LogHistogram()
         # arrival-vs-completion: cumulative intake count + EMA rates
         self._intake_total = 0
         self._rate_state: Optional[tuple[float, float, float]] = None
@@ -459,13 +488,21 @@ class AdmissionController:
         priority: int,
         triggered_by: str = TRIGGER_JOB_REGISTER,
         now: Optional[float] = None,
+        cost_demand: Optional[float] = None,
     ) -> None:
         """Gate an external submission BEFORE any state is committed.
 
         Under SHED: high admits, normal defers (429 + Retry-After — the
         client owns the retry), low sheds (longer Retry-After). Raises
         :class:`AdmissionRejected` for the latter two; nothing was
-        written, so no conservation law is at risk."""
+        written, so no conservation law is at risk.
+
+        ``cost_demand`` (see :func:`job_cost_demand`) orders the shed
+        WITHIN the low tier by class-cost-weighted demand: a low-tier
+        submission at or below the ``shed_cost_quantile`` of recently
+        seen demands defers like the normal tier instead of shedding —
+        the expensive half of the tier gives back capacity first.
+        Callers that pass no demand keep the legacy whole-tier shed."""
         self._note_intake()
         tier = tier_of(priority)
         if triggered_by in EXEMPT_TRIGGERS:
@@ -475,10 +512,20 @@ class AdmissionController:
         level = self._maybe_reevaluate(now=now)
         rejected: Optional[AdmissionRejected] = None
         with self._lock:
+            if tier == TIER_LOW and cost_demand is not None:
+                # profile continuously (not just under SHED) so the
+                # quantile is warm the moment shedding starts
+                self._cost_hist.record(max(float(cost_demand), 0.0))
             if level != SHED or tier == TIER_HIGH:
                 self._decide_locked(tier, "admitted")
             elif tier == TIER_NORMAL:
                 self._decide_locked(tier, "deferred")
+                rejected = AdmissionRejected(level, tier, "deferred", self.retry_after_s)
+            elif cost_demand is not None and float(cost_demand) <= (
+                self._cost_hist.percentile(self.shed_cost_quantile)
+            ):
+                self._decide_locked(tier, "deferred")
+                global_metrics.incr("nomad.admission.cost_spared_total")
                 rejected = AdmissionRejected(level, tier, "deferred", self.retry_after_s)
             else:
                 self._decide_locked(tier, "shed")
@@ -551,6 +598,10 @@ class AdmissionController:
                 ),
                 "counters": {tier: dict(c) for tier, c in self._counters.items()},
                 "exempt_total": self._exempt,
+                "cost_profile": {
+                    "count": self._cost_hist.count,
+                    "split": self._cost_hist.percentile(self.shed_cost_quantile),
+                },
                 "signals": self._last_signals.to_dict(),
                 "thresholds": {
                     "brownout_backlog": self.brownout_backlog,

@@ -278,8 +278,12 @@ class Server:
         if tg is None:
             raise KeyError(f"group not found: {group}")
         from ..structs.evaluation import TRIGGER_JOB_SCALING
+        from .admission import job_cost_demand
 
-        self.admission.check_intake(job.priority, TRIGGER_JOB_SCALING)
+        self.admission.check_intake(
+            job.priority, TRIGGER_JOB_SCALING,
+            cost_demand=job_cost_demand(job),
+        )
         if tg.scaling is not None and tg.scaling.enabled:
             if count < tg.scaling.min or (
                 tg.scaling.max and count > tg.scaling.max
@@ -427,7 +431,12 @@ class Server:
         # overload gate BEFORE any state commit: a shed register raises
         # AdmissionRejected (HTTP: 429 + Retry-After) with nothing
         # written, so job/eval conservation laws never see it
-        self.admission.check_intake(job.priority, TRIGGER_JOB_REGISTER)
+        from .admission import job_cost_demand
+
+        self.admission.check_intake(
+            job.priority, TRIGGER_JOB_REGISTER,
+            cost_demand=job_cost_demand(job),
+        )
         # periodic/parameterized jobs are templates: no eval until a child
         # is derived (job_endpoint.go Register skips eval creation for them)
         needs_eval = not job.is_periodic() and not job.is_parameterized()

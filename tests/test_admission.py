@@ -225,6 +225,67 @@ class TestCheckIntake:
         assert c.conserved()
 
 
+class TestCostAwareShed:
+    """Shedding WITHIN the low tier is ordered by class-cost-weighted
+    demand: the cheap half defers (retryable), the expensive half gives
+    back capacity first. Law 10 is untouched — the split only changes
+    WHICH decision a low-tier submission gets, never loses one."""
+
+    def test_cheap_low_defers_expensive_sheds(self):
+        clk = FakeClock()
+        c = controller(clock=clk, retry_after_s=2.0)
+        # warm the cost profile while NORMAL (everything still admits)
+        for demand in (1.0, 1.0, 100.0, 100.0):
+            c.check_intake(30, cost_demand=demand)
+        c.force_level(SHED, duration_s=3600.0, now=clk.t)
+        with pytest.raises(AdmissionRejected) as e:
+            c.check_intake(30, cost_demand=1.0)
+        assert e.value.decision == "deferred"
+        assert e.value.retry_after == pytest.approx(2.0)
+        with pytest.raises(AdmissionRejected) as e:
+            c.check_intake(30, cost_demand=100.0)
+        assert e.value.decision == "shed"
+        # legacy callers without a demand keep the whole-tier shed
+        with pytest.raises(AdmissionRejected) as e:
+            c.check_intake(30)
+        assert e.value.decision == "shed"
+        counts = c.counters()["low"]
+        assert counts["admitted"] == 4
+        assert counts["deferred"] == 1
+        assert counts["shed"] == 2
+        assert c.conserved()
+        assert c.snapshot()["cost_profile"]["count"] == 6
+
+    def test_job_cost_demand_weights_by_class_cost(self):
+        from nomad_tpu.server.admission import job_cost_demand
+        from nomad_tpu.structs.job import Job, Task, TaskGroup
+        from nomad_tpu.structs.resources import Resources
+
+        def mk(throughputs):
+            return Job(
+                id="j",
+                name="j",
+                task_groups=[
+                    TaskGroup(
+                        name="g",
+                        count=4,
+                        tasks=[Task(resources=Resources(cpu=500))],
+                    )
+                ],
+                throughputs=throughputs,
+            )
+
+        base = job_cost_demand(mk({}))
+        assert base == pytest.approx(4 * 0.5)  # count × cores, baseline
+        # costliest class the job targets wins (hetero's canonical table)
+        assert job_cost_demand(mk({"tpu-v5p": 2.0})) == pytest.approx(base * 4.0)
+        assert job_cost_demand(
+            mk({"cpu": 1.0, "gpu-h100": 3.0})
+        ) == pytest.approx(base * 5.0)
+        # unknown classes cost the 1.0 baseline, like class_cost_vector
+        assert job_cost_demand(mk({"fpga-x": 1.0})) == pytest.approx(base)
+
+
 # -- broker seam (post-commit defer) -----------------------------------------
 
 

@@ -103,8 +103,12 @@ class TestHTTPAPI:
             c.operator.scheduler_config()["scheduler_algorithm"] == "spread"
         )
         c.operator.set_scheduler_config(scheduler_algorithm="binpack")
-        with pytest.raises(APIException):
+        with pytest.raises(APIException) as e:
             c.operator.set_scheduler_config(scheduler_algorithm="bogus")
+        # registry error path: 400 names every registered algorithm
+        assert e.value.status == 400
+        assert "scheduler_algorithm must be one of" in str(e.value)
+        assert "cp-pack" in str(e.value)
 
     def test_deregister(self, harness):
         agent, c = harness

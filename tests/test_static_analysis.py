@@ -30,6 +30,7 @@ from nomad_tpu.analysis.rules.lockfields import LockDiscipline
 from nomad_tpu.analysis.rules.mergedsubmit import MergedSubmitDiscipline
 from nomad_tpu.analysis.rules.planfreeze import PlanMutationAfterSubmit
 from nomad_tpu.analysis.rules.shardingseam import ShardingSeamDiscipline
+from nomad_tpu.analysis.rules.solverseam import SolverSeamDiscipline
 from nomad_tpu.analysis.rules.spans import SpanCoverage
 from nomad_tpu.analysis.rules.swallow import SilentExceptionSwallow
 from nomad_tpu.analysis.rules.wallclock import BareWallClockInBrokerServer
@@ -804,6 +805,70 @@ class TestNTA015:
             ), rel
 
 
+# -- NTA016: the CP solver is invoked only through the registry seam -------
+
+
+class TestNTA016:
+    BAD = (
+        "from ..device.cp import cp_place_kernel\n"
+        "def fast_path(batch):\n"
+        "    return cp_place_kernel(batch.capacity, batch.used)\n"
+    )
+
+    def test_direct_kernel_call_in_scheduler_triggers(self):
+        fs = run(self.BAD, "nomad_tpu/scheduler/shortcut.py",
+                 SolverSeamDiscipline)
+        assert rule_ids(fs) == ["NTA016"]
+        assert fs[0].symbol == "fast_path"
+
+    def test_direct_wrapper_construction_in_server_triggers(self):
+        src = (
+            "from ..scheduler.cp import CpPlacementKernel, build_cp_batch\n"
+            "def place(ct, asks):\n"
+            "    b = build_cp_batch(ct, asks)\n"
+            "    return CpPlacementKernel().place(ct, asks), b\n"
+        )
+        fs = run(src, "nomad_tpu/server/fastlane.py",
+                 SolverSeamDiscipline)
+        assert rule_ids(fs) == ["NTA016", "NTA016"]
+
+    def test_registry_routed_dispatch_is_clean(self):
+        src = (
+            "from .algorithms import make_kernel\n"
+            "def place(cfg, ct, asks):\n"
+            "    return make_kernel(cfg.scheduler_algorithm).place(ct, asks)\n"
+        )
+        assert run(src, "nomad_tpu/scheduler/custom.py",
+                   SolverSeamDiscipline) == []
+
+    def test_registry_and_cp_seam_are_exempt(self):
+        for rel in (
+            "nomad_tpu/scheduler/algorithms.py",
+            "nomad_tpu/scheduler/cp.py",
+        ):
+            assert run(self.BAD, rel, SolverSeamDiscipline) == []
+
+    def test_device_package_is_out_of_scope(self):
+        # parity pinning calls the kernel and oracle directly by design
+        assert run(self.BAD, "nomad_tpu/device/parity.py",
+                   SolverSeamDiscipline) == []
+
+    def test_scheduler_and_server_at_head_are_clean(self):
+        """Zero direct solver invocations to ratchet: every caller goes
+        through the cp-pack plugin."""
+        for rel in (
+            ("nomad_tpu", "scheduler", "generic.py"),
+            ("nomad_tpu", "scheduler", "system.py"),
+            ("nomad_tpu", "server", "server.py"),
+        ):
+            path = os.path.join(REPO_ROOT, *rel)
+            with open(path) as f:
+                src = f.read()
+            assert (
+                run(src, "/".join(rel), SolverSeamDiscipline) == []
+            ), rel
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -874,7 +939,7 @@ class TestBaselineRatchet:
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
             "NTA007", "NTA008", "NTA009", "NTA010", "NTA011", "NTA012",
-            "NTA013", "NTA014", "NTA015",
+            "NTA013", "NTA014", "NTA015", "NTA016",
         ]
 
 
