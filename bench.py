@@ -713,6 +713,21 @@ def mesh_block(n_nodes: int = 0) -> dict:
     return out
 
 
+def kernel_fingerprints_block() -> dict:
+    """Canonical jaxpr fingerprints (jaxlint JXL006) for every traced_jit
+    kernel this bench process actually traced, keyed kernel -> config
+    label -> hash. Embedded in every mode's detail block so cross-run
+    records prove "same program, different wall-clock" (or expose that a
+    perf delta came with a jaxpr change) without re-running anything.
+    Best-effort: a bench line must never die in the analyzer."""
+    try:
+        from nomad_tpu.analysis.jaxlint import fingerprint_table
+
+        return fingerprint_table()
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 def bench_soak(argv: list, batch_workers: int) -> dict:
     """`bench.py soak` — steady-state SLO soak: seeded Poisson arrivals
     + node churn against a live cluster, reported as the canonical SLO
@@ -914,6 +929,7 @@ def main():
                     "detail": {
                         "kernel": k,
                         "mesh": mesh_block(n_nodes),
+                        "kernel_fingerprints": kernel_fingerprints_block(),
                         "probe_diag": _fallback_diag(),
                     },
                 }
@@ -926,6 +942,7 @@ def main():
 
         d = bench_soak(sys.argv[2:], batch_workers)
         d["mesh"] = mesh_block(d["nodes"])
+        d["kernel_fingerprints"] = kernel_fingerprints_block()
         ev = d["slo"]["eval_latency_ms"]
         print(
             json.dumps(
@@ -964,6 +981,7 @@ def main():
             n_nodes=n_nodes, n_jobs=n_jobs, count_per_job=count, seed=42
         )
         d["mesh"] = mesh_block(n_nodes)
+        d["kernel_fingerprints"] = kernel_fingerprints_block()
         print(
             json.dumps(
                 {
@@ -1002,6 +1020,7 @@ def main():
             n_nodes=n_nodes, n_jobs=n_jobs, count_per_job=count, seed=42
         )
         d["mesh"] = mesh_block(n_nodes)
+        d["kernel_fingerprints"] = kernel_fingerprints_block()
         print(
             json.dumps(
                 {
@@ -1032,6 +1051,7 @@ def main():
         count = int(sys.argv[4]) if len(sys.argv) > 4 else 250
         d = bench_explain(n_nodes=n_nodes, n_lanes=n_lanes, count=count)
         d["mesh"] = mesh_block(n_nodes)
+        d["kernel_fingerprints"] = kernel_fingerprints_block()
         print(
             json.dumps(
                 {
@@ -1056,6 +1076,7 @@ def main():
 
         grid = bench_grid()
         grid["mesh"] = mesh_block(10_000)  # largest grid cell's bucket
+        grid["kernel_fingerprints"] = kernel_fingerprints_block()
         best = max(c["allocs_per_sec"] for c in grid["cells"])
         print(
             json.dumps(
@@ -1095,7 +1116,11 @@ def main():
                     else 1.0,
                     "platform": jax.devices()[0].platform,
                     "fallback": fallback,
-                    "detail": {"mesh": mesh_block(), **suite},
+                    "detail": {
+                        "mesh": mesh_block(),
+                        "kernel_fingerprints": kernel_fingerprints_block(),
+                        **suite,
+                    },
                 }
             )
         )
@@ -1109,6 +1134,7 @@ def main():
 
         r = bench_replay(path)
         r["mesh"] = mesh_block()
+        r["kernel_fingerprints"] = kernel_fingerprints_block()
         print(
             json.dumps(
                 {
@@ -1163,6 +1189,7 @@ def main():
                 "fallback": fallback,
                 "detail": {
                     "mesh": mesh_block(n_nodes),
+                    "kernel_fingerprints": kernel_fingerprints_block(),
                     "kernel": kernel,
                     "end_to_end": e2e,
                     # lane-partitioned multi-worker scaling: workers,

@@ -1,7 +1,8 @@
 """nomad_tpu.analysis — repo-specific static analysis & runtime checkers.
 
-Three engines behind one CLI (``python -m nomad_tpu.analysis``) and one
-fast pytest entry point (tests/test_static_analysis.py):
+Four engines behind one CLI (``python -m nomad_tpu.analysis``) and two
+fast pytest entry points (tests/test_static_analysis.py,
+tests/test_jaxlint.py):
 
 - ``lint``    — an AST visitor framework plus repo-specific rules
   (NTA001–NTA008) that encode the invariants the north star depends on
@@ -19,13 +20,33 @@ fast pytest entry point (tests/test_static_analysis.py):
   ``utils.backend.traced_jit`` wrapper maintains for the hot-path device
   kernels; a kernel that silently retraces past its declared budget
   across a bench batch fails the check.
+- ``jaxlint`` — static analysis over the *traced* kernel fleet: every
+  ``traced_jit`` kernel is re-traced abstractly from its recorded call
+  specs and its ClosedJaxpr checked for host callbacks, baked host
+  constants, dtype/weak-type leaks, nondeterministic primitives, and
+  retrace hazards (JXL001–JXL005), plus canonical jaxpr fingerprints
+  and the mesh/explain invariance differ (JXL006). Kept jax-free at
+  import: ``python -m nomad_tpu.analysis --source-only`` never touches
+  jax.
 
-Lint findings diff against the checked-in ``analysis/baseline.json``:
-pre-existing violations are ratcheted (they stay visible and must not
-grow), new ones fail the run. ``--fix-baseline`` regenerates the file
+Lint findings diff against the checked-in baselines
+(``analysis/baseline.json`` for source rules,
+``analysis/jaxlint/baseline.json`` for jaxpr rules): pre-existing
+violations are ratcheted (they stay visible and must not grow), new
+ones fail the run. ``--fix-baseline`` regenerates both files
 deterministically (sorted, path-relative).
 """
 
 from . import lint, race, retrace  # noqa: F401
 
-__all__ = ["lint", "race", "retrace"]
+__all__ = ["jaxlint", "lint", "race", "retrace"]
+
+
+def __getattr__(name):
+    # lazy: jaxlint pulls in jax at analysis time, and plain
+    # `import nomad_tpu.analysis` (the source lint path) must not
+    if name == "jaxlint":
+        from . import jaxlint
+
+        return jaxlint
+    raise AttributeError(name)

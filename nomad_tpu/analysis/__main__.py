@@ -1,15 +1,20 @@
 """CLI: ``python -m nomad_tpu.analysis``.
 
-Default action: lint the repo, diff against the checked-in baseline,
-exit 1 on any NEW finding (pre-existing baselined findings are reported
-as ratcheted, not blocking). ``--fix-baseline`` regenerates the baseline
-deterministically (sorted entries, path-relative, line-number-free
-fingerprints) — run it after fixing violations so the ratchet tightens.
+Default action: BOTH analyses in one invocation — the NTA source lint
+(AST over the repo tree) and the JXL jaxpr lint (re-traced device-kernel
+fleet) — each diffed against its own checked-in baseline, with the
+combined exit code (1 if either surfaced a new finding). Pre-existing
+baselined findings are reported as ratcheted, not blocking.
+``--fix-baseline`` regenerates BOTH baselines deterministically (sorted
+entries, path-relative, line-number-free fingerprints) — run it after
+fixing violations so the ratchets tighten.
 
-    python -m nomad_tpu.analysis                  # lint vs baseline
+    python -m nomad_tpu.analysis                  # source + kernels
+    python -m nomad_tpu.analysis --source-only    # AST rules only (fast)
+    python -m nomad_tpu.analysis --kernels-only   # jaxpr rules only
     python -m nomad_tpu.analysis --json           # machine-readable
-    python -m nomad_tpu.analysis --rules NTA003   # subset of rules
-    python -m nomad_tpu.analysis --fix-baseline   # regenerate baseline
+    python -m nomad_tpu.analysis --rules NTA003   # subset (implies source)
+    python -m nomad_tpu.analysis --fix-baseline   # regenerate baseline(s)
     python -m nomad_tpu.analysis --retrace-report # jit budget registry
 """
 
@@ -26,7 +31,8 @@ from . import lint
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m nomad_tpu.analysis",
-        description="repo-specific static analysis (NTA001-NTA009)",
+        description="repo-specific static analysis: NTA source rules + "
+        "JXL traced-kernel rules",
     )
     p.add_argument(
         "paths", nargs="*",
@@ -38,17 +44,33 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--baseline", type=Path, default=None,
-        help="baseline file (default: nomad_tpu/analysis/baseline.json)",
+        help="source baseline file (default: "
+        "nomad_tpu/analysis/baseline.json)",
+    )
+    p.add_argument(
+        "--kernel-baseline", type=Path, default=None,
+        help="jaxpr baseline file (default: "
+        "nomad_tpu/analysis/jaxlint/baseline.json)",
     )
     p.add_argument(
         "--rules", default=None,
-        help="comma-separated rule ids to run (default: all)",
+        help="comma-separated source rule ids to run (default: all; "
+        "implies --source-only)",
     )
     p.add_argument(
         "--fix-baseline", action="store_true",
-        help="regenerate the baseline from current findings and exit 0",
+        help="regenerate the baseline(s) from current findings, exit 0",
     )
     p.add_argument("--json", action="store_true", help="JSON output")
+    only = p.add_mutually_exclusive_group()
+    only.add_argument(
+        "--source-only", action="store_true",
+        help="run only the NTA source lint (no jax import, no tracing)",
+    )
+    only.add_argument(
+        "--kernels-only", action="store_true",
+        help="run only the JXL jaxpr lint over the traced kernel fleet",
+    )
     p.add_argument(
         "--retrace-report", action="store_true",
         help="print the jit trace-count/budget registry and exit "
@@ -63,51 +85,109 @@ def main(argv=None) -> int:
         print(json.dumps(retrace.report(), indent=2))
         return 0
 
-    root = (args.root or lint.repo_root()).resolve()
-    rules = None
-    if args.rules:
-        wanted = {r.strip().upper() for r in args.rules.split(",")}
-        rules = [r for r in lint.all_rules() if r.id in wanted]
-        missing = wanted - {r.id for r in rules}
-        if missing:
-            print(f"unknown rules: {', '.join(sorted(missing))}",
-                  file=sys.stderr)
-            return 2
+    run_source = not args.kernels_only
+    run_kernels = not args.source_only and not args.rules and not args.paths
 
-    findings = lint.run_lint(root, paths=args.paths or None, rules=rules)
+    out = {"source": None, "kernels": None}
+    exit_code = 0
 
-    baseline_path = args.baseline or lint.default_baseline_path()
-    if args.fix_baseline:
-        lint.write_baseline(findings, baseline_path)
-        print(
-            f"baseline regenerated: {len(findings)} finding(s) -> "
-            f"{baseline_path}"
+    if run_source:
+        root = (args.root or lint.repo_root()).resolve()
+        rules = None
+        if args.rules:
+            wanted = {r.strip().upper() for r in args.rules.split(",")}
+            rules = [r for r in lint.all_rules() if r.id in wanted]
+            missing = wanted - {r.id for r in rules}
+            if missing:
+                print(f"unknown rules: {', '.join(sorted(missing))}",
+                      file=sys.stderr)
+                return 2
+        findings = lint.run_lint(
+            root, paths=args.paths or None, rules=rules
         )
-        return 0
+        baseline_path = args.baseline or lint.default_baseline_path()
+        if args.fix_baseline:
+            lint.write_baseline(findings, baseline_path)
+            out["source"] = {"regenerated": len(findings)}
+        else:
+            baseline = lint.load_baseline(baseline_path)
+            new, fixed = lint.diff_against_baseline(findings, baseline)
+            out["source"] = {
+                "new": new,
+                "ratcheted": len(findings) - len(new),
+                "fixed": sorted(fixed),
+            }
+            exit_code |= 1 if new else 0
 
-    baseline = lint.load_baseline(baseline_path)
-    new, fixed = lint.diff_against_baseline(findings, baseline)
-    ratcheted = len(findings) - len(new)
+    if run_kernels:
+        from .jaxlint import engine
+
+        kb = args.kernel_baseline or engine.default_baseline_path()
+        code, new, fixed, reports = engine.run_jaxlint(
+            baseline_path=kb, fix_baseline=args.fix_baseline
+        )
+        out["kernels"] = {
+            "new": new,
+            "fixed": sorted(fixed),
+            "analyzed": len(reports),
+            "configs": sum(len(r["configs"]) for r in reports.values()),
+        }
+        exit_code |= code
 
     if args.json:
-        print(json.dumps({
-            "new": [f.__dict__ | {"fingerprint": f.fingerprint} for f in new],
-            "ratcheted": ratcheted,
-            "fixed": sorted(fixed),
-        }, indent=2))
-    else:
-        for f in new:
-            print(f.render())
-        if fixed:
+        def enc(section):
+            if section is None or "new" not in section:
+                return section
+            return section | {"new": [
+                f.__dict__ | {"fingerprint": f.fingerprint}
+                for f in section["new"]
+            ]}
+
+        print(json.dumps(
+            {k: enc(v) for k, v in out.items()}, indent=2
+        ))
+        return exit_code
+
+    if args.fix_baseline:
+        if out["source"] is not None:
             print(
-                f"note: {len(fixed)} baselined finding(s) no longer fire — "
-                f"run --fix-baseline to tighten the ratchet"
+                f"source baseline regenerated: "
+                f"{out['source']['regenerated']} finding(s)"
             )
+        if out["kernels"] is not None:
+            print(
+                f"kernel baseline regenerated: "
+                f"{len(out['kernels']['new'])} new finding(s) absorbed"
+            )
+        return 0
+
+    for section, label in ((out["source"], "source"),
+                           (out["kernels"], "kernels")):
+        if section is None:
+            continue
+        for f in section["new"]:
+            print(f.render())
+        if section["fixed"]:
+            print(
+                f"note: {len(section['fixed'])} baselined {label} "
+                "finding(s) no longer fire — run --fix-baseline to "
+                "tighten the ratchet"
+            )
+    src = out["source"]
+    if src is not None:
         print(
-            f"{len(new)} new finding(s), {ratcheted} ratcheted "
-            f"(baselined), {len(fixed)} fixed"
+            f"source: {len(src['new'])} new finding(s), "
+            f"{src['ratcheted']} ratcheted (baselined), "
+            f"{len(src['fixed'])} fixed"
         )
-    return 1 if new else 0
+    ker = out["kernels"]
+    if ker is not None:
+        print(
+            f"kernels: {len(ker['new'])} new finding(s) across "
+            f"{ker['analyzed']} kernel(s) / {ker['configs']} config(s), "
+            f"{len(ker['fixed'])} fixed"
+        )
+    return exit_code
 
 
 if __name__ == "__main__":

@@ -1,0 +1,44 @@
+"""jaxlint — static analysis over the *traced* device-kernel fleet.
+
+The NTA rules (``analysis.rules``) read Python source; they cannot see
+what tracing actually produced. This package closes that gap: the
+``utils.backend.traced_jit`` registry keeps every kernel's un-jitted
+body and last-seen abstract call specs, the retracer turns a spec back
+into a ``ClosedJaxpr`` via ``jax.make_jaxpr`` (no data, no device), and
+the JXL rules walk that program:
+
+- JXL001  host-callback purity (no pure/io/debug callbacks)
+- JXL002  transfer hygiene (no large host constants baked into the jaxpr)
+- JXL003  dtype discipline (no 64-bit avals, no weak-typed outputs)
+- JXL004  nondeterministic primitives (unordered scatters, unstable sorts)
+- JXL005  retrace hazards (closure scalars, phantom statics, no budget)
+- JXL006  canonical jaxpr fingerprints + the invariance differ
+          (``jaxlint.diff``): mesh-on/off and explain-on/off proven
+          fleet-wide as fingerprint equalities
+
+Findings ratchet against ``jaxlint/baseline.json`` exactly like the
+source lint. Run via ``python -m nomad_tpu.analysis`` (combined) or
+``nomad-tpu analyze kernels``.
+"""
+
+from .engine import analyze_kernels, default_baseline_path, run_jaxlint
+from .fingerprint import (
+    canonical_text,
+    fingerprint,
+    fingerprint_table,
+    reset_fingerprint_cache,
+)
+from .retracer import UnretraceableSpec, import_fleet, retrace
+
+__all__ = [
+    "UnretraceableSpec",
+    "analyze_kernels",
+    "canonical_text",
+    "default_baseline_path",
+    "fingerprint",
+    "fingerprint_table",
+    "import_fleet",
+    "reset_fingerprint_cache",
+    "retrace",
+    "run_jaxlint",
+]

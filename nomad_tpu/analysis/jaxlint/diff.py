@@ -1,0 +1,167 @@
+"""JXL006 differ — prove whole-fleet jaxpr-identity claims.
+
+The repo's perf story leans on several "this feature does not change the
+compiled program" claims: mesh sharding placement (the jaxpr is a pure
+function of avals + declared statics; the ambient mesh must not leak
+into tracing), placement explainability (``explain=`` is a host-side
+gate, never a second jitted program), and the class-less throughput gate
+(``throughputs=None`` routes to the same base program). Before this
+module those were scattered per-test spot checks; here they are proven
+fleet-wide by re-tracing every recorded kernel config under both ambient
+states and comparing canonical fingerprints.
+
+Each prover returns a report dict (per-kernel, per-config fingerprints
+on both sides plus an overall ``ok``) rather than asserting, so the CLI
+can print it and tests can pin it.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .fingerprint import fingerprint
+from . import retracer
+
+_MESH_ENV = "NOMAD_TPU_MESH"
+
+
+def _fingerprints_here(entries) -> dict:
+    """{kernel short: {sig: fp}} re-traced under the CURRENT ambient
+    state. Deliberately bypasses the fingerprint cache — the point is to
+    observe what tracing does right now."""
+    out: dict = {}
+    for entry in entries:
+        per = {}
+        for sig, spec in entry.specs.items():
+            per[sig] = fingerprint(retracer.retrace(entry, spec))
+        out[entry.short] = per
+    return out
+
+
+def prove_mesh_invariance(registry=None) -> dict:
+    """Re-trace every recorded production config with the mesh forced
+    off and forced on, and compare fingerprints.
+
+    Proves the ambient mesh cannot leak into a traced program: sharding
+    enters only through explicitly declared statics (``n_shards`` rows
+    appear as their own configs in the fingerprint table) and input
+    shardings, never by changing the jaxpr. Needs >1 visible device to
+    actually activate a mesh; reports ``skipped`` otherwise.
+    """
+    import jax
+
+    from ...utils import backend
+
+    if registry is None:
+        registry = retracer.import_fleet()
+    entries = [
+        e for e in retracer.production_kernels(registry).values()
+        if e.specs
+    ]
+    if len(jax.devices()) <= 1:
+        return {
+            "claim": "mesh-on/off jaxpr equality",
+            "ok": True,
+            "skipped": "needs >1 visible device to activate a mesh",
+            "kernels": {},
+        }
+    prev = os.environ.get(_MESH_ENV)
+    try:
+        os.environ[_MESH_ENV] = "off"
+        backend.reset_mesh()
+        fps_off = _fingerprints_here(entries)
+        os.environ[_MESH_ENV] = "auto"
+        backend.reset_mesh()
+        mesh_shape = [backend.get_mesh().dp, backend.get_mesh().mp]
+        fps_on = _fingerprints_here(entries)
+    finally:
+        if prev is None:
+            os.environ.pop(_MESH_ENV, None)
+        else:
+            os.environ[_MESH_ENV] = prev
+        backend.reset_mesh()
+    kernels: dict = {}
+    ok = True
+    by_short = {e.short: e for e in entries}
+    for short in sorted(fps_off):
+        rows = {}
+        for sig in fps_off[short]:
+            label = retracer.spec_label(by_short[short], sig)
+            equal = fps_off[short][sig] == fps_on[short][sig]
+            ok = ok and equal
+            rows[label] = {
+                "mesh_off": fps_off[short][sig],
+                "mesh_on": fps_on[short][sig],
+                "equal": equal,
+            }
+        kernels[short] = rows
+    return {
+        "claim": "mesh-on/off jaxpr equality",
+        "ok": ok,
+        "mesh_shape": mesh_shape,
+        "kernels": kernels,
+    }
+
+
+def prove_explain_invariance() -> dict:
+    """Run the placement exercise with ``explain=False`` then
+    ``explain=True`` and prove the explain path added no traced program:
+    zero new XLA traces, zero new recorded specs, and every config's
+    fingerprint unchanged.
+    """
+    from ...utils import backend
+    from .exercise import run_placement_paths
+
+    registry = retracer.import_fleet()
+    run_placement_paths(explain=False)
+    entries = [
+        e for e in retracer.production_kernels(registry).values()
+        if e.specs
+    ]
+    specs_before = {e.short: set(e.specs) for e in entries}
+    traces_before = backend.trace_counts()
+    fps_before = _fingerprints_here(entries)
+
+    run_placement_paths(explain=True)
+    traces_after = backend.trace_counts()
+    fps_after = _fingerprints_here(entries)
+
+    kernels: dict = {}
+    ok = True
+    for e in entries:
+        added_specs = sorted(set(e.specs) - specs_before[e.short])
+        added_traces = traces_after.get(e.name, 0) - traces_before.get(
+            e.name, 0
+        )
+        fp_equal = fps_before[e.short] == {
+            s: fps_after[e.short][s] for s in specs_before[e.short]
+        }
+        kernel_ok = not added_specs and added_traces == 0 and fp_equal
+        ok = ok and kernel_ok
+        kernels[e.short] = {
+            "added_specs": added_specs,
+            "added_traces": added_traces,
+            "fingerprints_equal": fp_equal,
+            "ok": kernel_ok,
+        }
+    return {
+        "claim": "explain-on/off adds no traced program",
+        "ok": ok,
+        "kernels": kernels,
+    }
+
+
+def prove_all() -> dict:
+    """Both fleet invariants; ``ok`` is the conjunction. The full fleet
+    exercise runs between the provers so the mesh differ covers every
+    production kernel (hetero, cp, preemption, score-matrix), not just
+    the placement paths the explain prover drives."""
+    from .exercise import exercise_fleet
+
+    explain = prove_explain_invariance()
+    mesh = prove_mesh_invariance(exercise_fleet())
+    return {
+        "ok": explain["ok"] and mesh["ok"],
+        "explain": explain,
+        "mesh": mesh,
+    }

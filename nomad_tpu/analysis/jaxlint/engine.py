@@ -1,0 +1,106 @@
+"""Analyzer driver: exercise the fleet, re-trace, run JXL rules, ratchet.
+
+Mirrors the NTA source-lint engine (``analysis.lint``): findings carry
+line-free fingerprints, ``baseline.json`` next to this module is the
+accepted-debt ledger, new findings fail, fixed findings are pruned with
+``--fix-baseline``. The two engines share the Finding type and baseline
+format so ``python -m nomad_tpu.analysis`` can combine them in one run.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from ..lint import (
+    Finding,
+    diff_against_baseline,
+    load_baseline,
+    write_baseline,
+)
+from . import retracer, rules
+
+
+def default_baseline_path() -> Path:
+    return Path(__file__).resolve().parent / "baseline.json"
+
+
+def analyze_kernels(
+    registry=None, exercise: bool = True
+) -> tuple[list[Finding], dict]:
+    """Run JXL001-JXL005 over every production kernel.
+
+    Returns ``(findings, reports)`` where ``reports`` maps kernel name to
+    a per-kernel dict: registry metadata (``describe()``), the configs
+    analyzed, and finding counts. When ``exercise`` is true and a
+    production kernel has no recorded spec yet, the synthetic fleet
+    workload (``jaxlint.exercise``) runs first so a cold process — the
+    CLI, a fresh pytest worker — still sees the whole fleet.
+    """
+    from ...utils import backend
+
+    if registry is None:
+        registry = retracer.import_fleet()
+    prod = retracer.production_kernels(registry)
+    if exercise and any(not e.specs for e in prod.values()):
+        from .exercise import exercise_fleet
+
+        registry = exercise_fleet()
+        prod = retracer.production_kernels(registry)
+
+    findings: list[Finding] = []
+    reports: dict[str, dict] = {}
+    for name, entry in prod.items():
+        report = entry.describe()
+        report["configs"] = []
+        kernel_findings: list[Finding] = []
+        if not entry.specs:
+            kernel_findings.append(rules._finding(
+                entry, "JXL005",
+                "kernel registered but never called: no recorded spec to "
+                "analyze — add it to the exercise workload",
+            ))
+        for sig in list(entry.specs):
+            label = retracer.spec_label(entry, sig)
+            report["configs"].append(label)
+            try:
+                closed = retracer.retrace(entry, entry.specs[sig])
+            except retracer.UnretraceableSpec as e:
+                kernel_findings.append(rules._finding(
+                    entry, "JXL005", f"unretraceable spec ({e}) — the "
+                    "analyzer cannot audit this config",
+                ))
+                continue
+            kernel_findings.extend(rules.check_kernel(entry, closed))
+        if entry.specs:
+            # registry-level findings are per-kernel, not per-config;
+            # check_kernel appended them once per spec — dedupe
+            seen: set[str] = set()
+            unique = []
+            for f in sorted(
+                kernel_findings, key=lambda f: (f.rule, f.message)
+            ):
+                if f.fingerprint not in seen:
+                    seen.add(f.fingerprint)
+                    unique.append(f)
+            kernel_findings = unique
+        report["findings"] = len(kernel_findings)
+        reports[name] = report
+        findings.extend(kernel_findings)
+    findings.sort(key=lambda f: (f.path, f.symbol, f.rule, f.message))
+    return findings, reports
+
+
+def run_jaxlint(
+    baseline_path: Path | None = None,
+    fix_baseline: bool = False,
+) -> tuple[int, list[Finding], set[str], dict]:
+    """Full ratcheted run. Returns (exit_code, new_findings,
+    fixed_fingerprints, per-kernel reports)."""
+    path = baseline_path or default_baseline_path()
+    findings, reports = analyze_kernels()
+    baseline = load_baseline(path)
+    new, fixed = diff_against_baseline(findings, baseline)
+    if fix_baseline:
+        write_baseline(findings, path)
+        return 0, new, fixed, reports
+    return (1 if new else 0), new, fixed, reports

@@ -1,0 +1,148 @@
+"""Registry -> ClosedJaxpr: re-trace a registered kernel abstractly.
+
+``utils.backend.traced_jit`` records, per kernel, the original un-jitted
+body plus the abstract call specs seen at trace time (dynamic args as
+(shape, dtype, weak_type) avals, static args as Python values). This
+module turns one such spec back into a ``jax.make_jaxpr`` call: static
+values are baked in exactly as ``jax.jit(static_argnames=...)`` would
+bake them, dynamic slots become ``ShapeDtypeStruct`` avals, and the
+result is the SAME jaxpr the production dispatch traced — without ever
+materializing data or touching a device.
+"""
+
+from __future__ import annotations
+
+from ...utils import backend
+
+#: kernels whose fully-qualified name starts with one of these prefixes
+#: are "the production fleet" — test-fixture kernels registered by a
+#: pytest process are excluded from whole-fleet checks by default.
+PRODUCTION_PREFIXES = ("nomad_tpu.",)
+
+
+class UnretraceableSpec(ValueError):
+    """A recorded spec contains an argument the analyzer cannot rebuild
+    abstractly (an opaque Python object passed into a kernel)."""
+
+
+def import_fleet() -> dict:
+    """Import every module that defines production ``traced_jit``
+    kernels (decoration registers them), then return the registry."""
+    from ...device import cp, preempt, score  # noqa: F401
+    from ...scheduler import hetero  # noqa: F401
+
+    return backend.kernel_registry()
+
+
+def production_kernels(registry=None) -> dict:
+    reg = registry if registry is not None else backend.kernel_registry()
+    return {
+        name: entry
+        for name, entry in sorted(reg.items())
+        if name.startswith(PRODUCTION_PREFIXES)
+    }
+
+
+def _build_slot(spec_entry, dynamic_slots):
+    """("aval", ...) -> placeholder index appended to dynamic_slots;
+    ("static", v) -> the baked value."""
+    kind = spec_entry[0]
+    if kind == "static":
+        return spec_entry[1]
+    if kind == "aval":
+        import jax
+        import numpy as np
+
+        _, shape, dtype, weak = spec_entry
+        aval = jax.ShapeDtypeStruct(
+            tuple(shape), np.dtype(dtype), weak_type=bool(weak)
+        )
+        dynamic_slots.append(aval)
+        return _Dyn(len(dynamic_slots) - 1)
+    raise UnretraceableSpec(
+        f"opaque argument of type {spec_entry[1]!r} — the kernel was "
+        "called with a Python object the analyzer cannot abstract"
+    )
+
+
+class _Dyn:
+    __slots__ = ("idx",)
+
+    def __init__(self, idx):
+        self.idx = idx
+
+
+def retrace(entry, spec=None):
+    """Re-trace ``entry`` (a backend.KernelEntry) from ``spec`` (default:
+    its newest recorded spec). Returns a ``ClosedJaxpr``."""
+    import jax
+
+    if spec is None:
+        spec = entry.last_spec()
+    if spec is None:
+        raise UnretraceableSpec(
+            f"kernel {entry.name} has no recorded call spec — run the "
+            "exercise workload (jaxlint.exercise) or a bench first"
+        )
+    dynamic_slots: list = []
+    pos_template = [_build_slot(s, dynamic_slots) for s in spec["args"]]
+    kw_template = {
+        k: _build_slot(s, dynamic_slots) for k, s in spec["kwargs"].items()
+    }
+
+    def _call(*dyn):
+        pos = [dyn[t.idx] if isinstance(t, _Dyn) else t
+               for t in pos_template]
+        kw = {k: dyn[t.idx] if isinstance(t, _Dyn) else t
+              for k, t in kw_template.items()}
+        return entry.fn(*pos, **kw)
+
+    return jax.make_jaxpr(_call)(*dynamic_slots)
+
+
+def spec_label(entry, sig: str) -> str:
+    """Human label for one recorded spec: the static/Python-valued args
+    that distinguish configs of the same kernel (dynamic shapes are in
+    the sig itself, which can be long — statics are what operators
+    diff). Omitted trailing params with non-tensor defaults count too:
+    ``throughputs=None`` left at its default routes a Python gate and is
+    a different jit cache entry than a supplied array."""
+    import inspect
+
+    spec = entry.specs.get(sig)
+    if spec is None:
+        return sig[:64]
+    try:
+        params = list(inspect.signature(entry.fn).parameters.values())
+    except (TypeError, ValueError):
+        params = None
+
+    def pname(i):
+        if params is not None and i < len(params) and params[i].kind in (
+            inspect.Parameter.POSITIONAL_ONLY,
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        ):
+            return params[i].name
+        return f"arg{i}"
+
+    statics = []
+    for i, s in enumerate(spec["args"]):
+        if s[0] == "static":
+            statics.append(f"{pname(i)}={s[1]!r}")
+    for k, s in spec["kwargs"].items():
+        if s[0] == "static":
+            statics.append(f"{k}={s[1]!r}")
+    if params is not None:
+        for p in params[len(spec["args"]):]:
+            if (
+                p.name in spec["kwargs"]
+                or p.default is inspect.Parameter.empty
+                or p.kind not in (
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    inspect.Parameter.KEYWORD_ONLY,
+                )
+            ):
+                continue
+            statics.append(f"{p.name}={p.default!r}")
+    statics.sort()
+    return ", ".join(statics) if statics else "default"

@@ -1,0 +1,305 @@
+"""JXL001-JXL005 — rules over the traced device-kernel fleet.
+
+Unlike the NTA source rules (AST walks over Python files), these walk
+the ClosedJaxpr the analyzer re-traced from each kernel's recorded call
+spec — the program XLA actually compiles. Findings reuse the NTA
+``lint.Finding`` shape (and therefore the same line-number-free
+fingerprint ratchet): ``path`` is the kernel's defining module,
+``symbol`` is the kernel name, so a finding survives unrelated edits
+and leaves the baseline only when the traced program changes.
+
+Rule set:
+
+- **JXL001 host-callback purity** — no ``pure_callback`` /
+  ``io_callback`` / ``debug_callback`` primitives in production
+  kernels. A callback re-enters Python per executed step: it wedges
+  under the watchdog's poisoned-thread handoff, dies inside a donated
+  buffer, and silently serializes the batch.
+- **JXL002 transfer hygiene** — no large host constants baked into the
+  jaxpr. A closure-captured array becomes a ``const`` re-uploaded with
+  every compiled executable instead of flowing through the
+  ``shard_put`` seam as a sharded argument (NTA015 is the source-level
+  half of this check; JXL002 sees what tracing actually captured).
+- **JXL003 dtype discipline** — no f64/c128/x64 avals and no
+  weak-typed kernel outputs. The byte-parity oracles compare uint32
+  views of f32 buffers; a weak output or a 64-bit promotion changes
+  width with ambient x64 config and breaks them bitwise.
+- **JXL004 nondeterministic primitives** — no unordered multi-index
+  scatter accumulation (``scatter-add``/``mul``/``min``/``max`` with
+  ``unique_indices=False`` over >1 update) and no unstable sorts.
+  Their accumulation/tie order is implementation-defined, which breaks
+  bitwise reproducibility across backends.
+- **JXL005 retrace-hazard audit** — closure-captured Python scalars
+  (they bake silently into the trace: change the value, keep the
+  cache entry), declared static_argnames that don't exist in the
+  signature, and kernels with no declared retrace budget (the budget
+  checker in ``analysis.retrace`` can't see them).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+from ..lint import Finding
+
+# JXL001: primitives that re-enter Python from inside the program
+CALLBACK_PRIMS = frozenset(
+    {"pure_callback", "io_callback", "debug_callback", "outside_call"}
+)
+
+# JXL002: consts at or under this element count are scalars/lookup
+# tables legitimately baked by tracing (iota seeds, clamp bounds);
+# anything bigger is cluster-shaped data that must arrive as an arg
+CONST_ELEMS_MAX = 64
+
+# JXL003: dtypes that can't round-trip a uint32-view byte-parity oracle
+WIDE_DTYPES = frozenset({"float64", "complex128", "int64", "uint64"})
+
+# JXL004: scatter variants whose multi-update accumulation order is
+# implementation-defined for floats
+UNORDERED_SCATTERS = frozenset(
+    {"scatter-add", "scatter-mul", "scatter-min", "scatter-max"}
+)
+
+
+def kernel_path(entry) -> str:
+    """Repo-relative path of the kernel's defining module."""
+    return entry.fn.__module__.replace(".", "/") + ".py"
+
+
+def kernel_line(entry) -> int:
+    try:
+        return entry.fn.__code__.co_firstlineno
+    except AttributeError:
+        return 0
+
+
+def _finding(entry, rule: str, message: str) -> Finding:
+    return Finding(
+        rule=rule,
+        path=kernel_path(entry),
+        line=kernel_line(entry),
+        symbol=entry.short,
+        message=message,
+    )
+
+
+def iter_eqns(closed):
+    """Yield every equation in a ClosedJaxpr, recursing into sub-jaxpr
+    params (scan/while/cond bodies, pjit calls, scatter update fns)."""
+    stack = [closed.jaxpr]
+    while stack:
+        jaxpr = stack.pop()
+        for eqn in jaxpr.eqns:
+            yield eqn
+            for v in eqn.params.values():
+                stack.extend(_sub_jaxprs(v))
+
+
+def _sub_jaxprs(v):
+    if hasattr(v, "jaxpr"):  # ClosedJaxpr
+        return [v.jaxpr]
+    if hasattr(v, "eqns"):  # open Jaxpr (e.g. scatter update_jaxpr)
+        return [v]
+    if isinstance(v, (tuple, list)):
+        out = []
+        for x in v:
+            out.extend(_sub_jaxprs(x))
+        return out
+    return []
+
+
+def iter_consts(closed):
+    """Yield (const, owner) for the top jaxpr and every sub-jaxpr that
+    carries its own consts."""
+    for c in closed.consts:
+        yield c
+    seen = [closed.jaxpr]
+    while seen:
+        jaxpr = seen.pop()
+        for eqn in jaxpr.eqns:
+            for v in eqn.params.values():
+                for sub in _closed_subs(v):
+                    for c in sub.consts:
+                        yield c
+                    seen.append(sub.jaxpr)
+
+
+def _closed_subs(v):
+    if hasattr(v, "jaxpr"):
+        return [v]
+    if isinstance(v, (tuple, list)):
+        out = []
+        for x in v:
+            out.extend(_closed_subs(x))
+        return out
+    return []
+
+
+# -- jaxpr-level rules -------------------------------------------------------
+
+
+def check_callback_purity(entry, closed) -> list[Finding]:
+    """JXL001"""
+    out = []
+    seen = set()
+    for eqn in iter_eqns(closed):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMS and name not in seen:
+            seen.add(name)
+            out.append(_finding(
+                entry, "JXL001",
+                f"host callback primitive {name!r} in a production "
+                "kernel: the traced program re-enters Python per step — "
+                "hoist the host work outside the kernel",
+            ))
+    return out
+
+
+def check_transfer_hygiene(entry, closed) -> list[Finding]:
+    """JXL002"""
+    import numpy as np
+
+    out = []
+    for c in iter_consts(closed):
+        size = int(np.size(c)) if hasattr(c, "__len__") or hasattr(
+            c, "shape"
+        ) else 1
+        if size > CONST_ELEMS_MAX:
+            dt = getattr(c, "dtype", type(c).__name__)
+            shp = tuple(getattr(c, "shape", ()))
+            out.append(_finding(
+                entry, "JXL002",
+                f"host constant {dt}{list(shp)} ({size} elems) baked "
+                "into the jaxpr: closure-captured arrays re-upload per "
+                "executable — pass it as an argument through the "
+                "shard_put seam",
+            ))
+    return out
+
+
+def check_dtype_discipline(entry, closed) -> list[Finding]:
+    """JXL003"""
+    out = []
+    seen = set()
+    for eqn in iter_eqns(closed):
+        for v in eqn.outvars:
+            dt = str(getattr(v.aval, "dtype", ""))
+            if dt in WIDE_DTYPES and dt not in seen:
+                seen.add(dt)
+                out.append(_finding(
+                    entry, "JXL003",
+                    f"{dt} intermediate in the traced program: 64-bit "
+                    "promotion breaks the uint32-view byte-parity "
+                    "oracles — pin the dtype explicitly",
+                ))
+    for i, v in enumerate(closed.jaxpr.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None and getattr(aval, "weak_type", False):
+            out.append(_finding(
+                entry, "JXL003",
+                f"kernel output {i} is weak-typed "
+                f"({getattr(aval, 'dtype', '?')}): its width follows "
+                "ambient x64 config — cast explicitly before returning",
+            ))
+    return out
+
+
+def check_determinism(entry, closed) -> list[Finding]:
+    """JXL004"""
+    import numpy as np
+
+    out = []
+    flagged = set()
+    for eqn in iter_eqns(closed):
+        name = eqn.primitive.name
+        if name in UNORDERED_SCATTERS and not eqn.params.get(
+            "unique_indices", True
+        ):
+            # single-update scatters are order-free regardless of flags
+            idx_aval = eqn.invars[1].aval if len(eqn.invars) > 1 else None
+            n_updates = (
+                int(np.prod(idx_aval.shape[:-1]))
+                if idx_aval is not None and len(idx_aval.shape) > 0
+                else 1
+            )
+            if n_updates > 1 and name not in flagged:
+                flagged.add(name)
+                out.append(_finding(
+                    entry, "JXL004",
+                    f"{name} over {n_updates} updates with "
+                    "unique_indices=False: float accumulation order is "
+                    "implementation-defined — sort/segment the indices "
+                    "or assert uniqueness",
+                ))
+        if name == "sort" and not eqn.params.get("is_stable", True):
+            if "sort" not in flagged:
+                flagged.add("sort")
+                out.append(_finding(
+                    entry, "JXL004",
+                    "unstable sort in the traced program: tie order is "
+                    "implementation-defined — use stable=True",
+                ))
+    return out
+
+
+# -- registry-level rules ----------------------------------------------------
+
+
+def check_retrace_hazards(entry) -> list[Finding]:
+    """JXL005 — needs no jaxpr: audits the kernel's Python closure and
+    declared jit config against the retrace-budget discipline."""
+    out = []
+    fn = entry.fn
+    freevars = getattr(fn.__code__, "co_freevars", ())
+    cells = fn.__closure__ or ()
+    for name, cell in zip(freevars, cells):
+        try:
+            val = cell.cell_contents
+        except ValueError:  # empty cell
+            continue
+        if isinstance(val, (bool, int, float, str)):
+            out.append(_finding(
+                entry, "JXL005",
+                f"closure-captured Python scalar {name!r} "
+                f"({type(val).__name__}): it bakes into the trace "
+                "invisibly to the jit cache — declare it a static "
+                "argument instead",
+            ))
+    try:
+        params = set(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):
+        params = None
+    if params is not None:
+        for sa in entry.static_argnames:
+            if sa not in params:
+                out.append(_finding(
+                    entry, "JXL005",
+                    f"declared static argname {sa!r} is not a parameter "
+                    "of the kernel — the jit cache keys on a phantom",
+                ))
+    if entry.retrace_budget is None:
+        out.append(_finding(
+            entry, "JXL005",
+            "no retrace_budget declared: the retrace budget checker "
+            "(analysis.retrace) cannot audit this kernel — declare one",
+        ))
+    return out
+
+
+JAXPR_CHECKS = (
+    check_callback_purity,
+    check_transfer_hygiene,
+    check_dtype_discipline,
+    check_determinism,
+)
+
+
+def check_kernel(entry, closed) -> list[Finding]:
+    """All JXL findings for one kernel's traced program + registry row."""
+    findings: list[Finding] = []
+    for chk in JAXPR_CHECKS:
+        findings.extend(chk(entry, closed))
+    findings.extend(check_retrace_hazards(entry))
+    findings.sort(key=lambda f: (f.rule, f.message))
+    return findings

@@ -18,6 +18,7 @@ from .algorithmseam import AlgorithmSeamDiscipline  # noqa: E402
 from .scoredump import ScoreDumpDiscipline  # noqa: E402
 from .shardingseam import ShardingSeamDiscipline  # noqa: E402
 from .solverseam import SolverSeamDiscipline  # noqa: E402
+from .kernelseam import KernelSeamDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -36,6 +37,7 @@ REGISTRY = [
     ScoreDumpDiscipline,  # NTA014
     ShardingSeamDiscipline,  # NTA015
     SolverSeamDiscipline,  # NTA016
+    KernelSeamDiscipline,  # NTA017
 ]
 
 __all__ = ["REGISTRY"]

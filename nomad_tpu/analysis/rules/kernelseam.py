@@ -1,0 +1,68 @@
+"""NTA017 — device kernels go through the traced_jit seam.
+
+``utils/backend.py`` owns kernel compilation: ``traced_jit`` is the one
+wrapper that counts traces (the retrace-budget watchdog and the breaker
+read those counters), registers the kernel with the jaxlint analyzer
+(original body + abstract call specs, so JXL001-006 can re-trace it),
+and threads chaos/profiling hooks. A bare ``jax.jit`` anywhere else in
+the package produces a kernel that is invisible to ALL of that: it
+never appears in ``nomad-tpu analyze kernels``, its retraces don't trip
+the budget checker, and the fleet-wide fingerprint invariants silently
+exclude it. The failure mode is not a crash — it is an unaudited
+program shipping alongside nine audited ones.
+
+Flagged, anywhere in ``nomad_tpu/``: any reference to the dotted name
+``jax.jit`` (call, decorator, or ``functools.partial(jax.jit, ...)``
+argument) and any ``from jax import jit``.
+
+Exempt: ``utils/backend.py`` — the seam itself wraps ``jax.jit`` by
+construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..lint import Finding, Rule, ScopedVisitor, dotted_name
+
+_SCOPES = ("nomad_tpu/",)
+_EXEMPT = ("nomad_tpu/utils/backend.py",)
+
+_MSG = (
+    "bare jax.jit: compile device kernels with utils/backend.py "
+    "traced_jit so the kernel is trace-counted, budget-audited, and "
+    "visible to the jaxlint analyzer"
+)
+
+
+class _JitVisitor(ScopedVisitor):
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if dotted_name(node) == "jax.jit":
+            self.add("NTA017", node, _MSG)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "jax" and any(
+            a.name == "jit" for a in node.names
+        ):
+            self.add(
+                "NTA017",
+                node,
+                "from jax import jit: " + _MSG,
+            )
+        self.generic_visit(node)
+
+
+class KernelSeamDiscipline(Rule):
+    id = "NTA017"
+    title = "device kernels go through the traced_jit seam"
+
+    def applies_to(self, relpath: str) -> bool:
+        if relpath in _EXEMPT:
+            return False
+        return relpath.startswith(_SCOPES)
+
+    def check(self, tree, source, relpath) -> list[Finding]:
+        v = _JitVisitor(relpath)
+        v.visit(tree)
+        return v.findings

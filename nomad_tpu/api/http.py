@@ -1615,10 +1615,23 @@ class HTTPAgent:
             return trace
         from ..utils.backend import kernel_profile
 
+        # canonical jaxpr fingerprints for every kernel this process has
+        # traced (jaxlint JXL006): lets an operator diff two agents'
+        # compiled programs from their trace surfaces alone. Re-tracing
+        # is abstract (no compile) and cached per (kernel, spec); the
+        # flight-recorder surface must never 500 because a kernel spec
+        # went unretraceable, hence best-effort.
+        try:
+            from ..analysis.jaxlint import fingerprint_table
+
+            fingerprints = fingerprint_table()
+        except Exception:  # noqa: BLE001
+            fingerprints = {}
         return {
             "traces": flight_recorder.list(int(query.get("n", 50))),
             "errors": flight_recorder.errors(),
             "kernels": kernel_profile(),
+            "kernel_fingerprints": fingerprints,
         }
 
     def handle_agent_resilience(self, method, body, query):

@@ -1096,6 +1096,73 @@ def cmd_chaos_run(args) -> int:
     return 1
 
 
+def cmd_analyze_kernels(args) -> int:
+    """`nomad-tpu analyze kernels` — jaxpr lint over the traced fleet.
+    In-process (not behind the HTTP boundary): the analyzer re-traces
+    the kernels from the registry, which only exists where the kernels
+    are importable. Exit 0 when every finding is baselined, 1 on any
+    new finding or failed invariance proof."""
+    from ..analysis.jaxlint import engine, fingerprint_table
+
+    code, new, fixed, reports = engine.run_jaxlint(
+        fix_baseline=args.fix_baseline
+    )
+    fps = fingerprint_table()
+    diff_report = None
+    if args.diff:
+        from ..analysis.jaxlint.diff import prove_all
+
+        diff_report = prove_all()
+        code = code or (0 if diff_report["ok"] else 1)
+
+    if args.json:
+        print(json.dumps({
+            "kernels": {
+                name: r | {"fingerprints": fps.get(r["short"], {})}
+                for name, r in reports.items()
+            },
+            "new": [
+                f.__dict__ | {"fingerprint": f.fingerprint} for f in new
+            ],
+            "fixed": sorted(fixed),
+            "diff": diff_report,
+        }, indent=2, default=str))
+        return code
+
+    rows = [("Kernel", "Configs", "Findings", "Fingerprints")]
+    for name, r in sorted(reports.items()):
+        per = fps.get(r["short"], {})
+        rows.append((
+            r["short"],
+            str(len(r["configs"])),
+            str(r["findings"]),
+            "; ".join(
+                f"{label}: {fp}" for label, fp in sorted(per.items())
+            ) or "-",
+        ))
+    w = [max(len(r[i]) for r in rows) for i in range(4)]
+    for r in rows:
+        print("  ".join(v.ljust(x) for v, x in zip(r, w)))
+    for f in new:
+        print(f.render())
+    if fixed:
+        print(
+            f"note: {len(fixed)} baselined finding(s) no longer fire — "
+            "run --fix-baseline to tighten the ratchet"
+        )
+    if diff_report is not None:
+        for key in ("explain", "mesh"):
+            rep = diff_report[key]
+            status = "SKIP" if rep.get("skipped") else (
+                "OK" if rep["ok"] else "FAIL"
+            )
+            print(f"invariant [{status}] {rep['claim']}")
+    print(
+        f"{len(new)} new finding(s) across {len(reports)} kernel(s)"
+    )
+    return code
+
+
 def cmd_operator_raft_list(args) -> int:
     """`nomad operator raft list-peers`
     (command/operator_raft_list.go)."""
@@ -1586,6 +1653,26 @@ def build_parser() -> argparse.ArgumentParser:
                       help="on violation, shrink to a minimal failing "
                       "fault subset")
     crun.set_defaults(fn=cmd_chaos_run)
+
+    analyze = sub.add_parser(
+        "analyze", help="static analysis over the traced kernel fleet"
+    ).add_subparsers(dest="analyze_cmd", required=True)
+    akern = analyze.add_parser(
+        "kernels",
+        help="re-trace every traced_jit kernel, run the JXL rules, and "
+        "print the fingerprint table (ratchets vs jaxlint/baseline.json)",
+    )
+    akern.add_argument("--json", action="store_true")
+    akern.add_argument(
+        "--fix-baseline", action="store_true",
+        help="absorb current findings into the jaxpr baseline and exit 0",
+    )
+    akern.add_argument(
+        "--diff", action="store_true",
+        help="also run the JXL006 invariance differ (mesh-on/off and "
+        "explain-on/off jaxpr equality, fleet-wide)",
+    )
+    akern.set_defaults(fn=cmd_analyze_kernels)
 
     return p
 

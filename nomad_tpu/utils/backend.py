@@ -29,6 +29,104 @@ _trace_lock = threading.Lock()
 _trace_counts: dict[str, int] = {}
 _trace_budgets: dict[str, int] = {}
 
+# -- kernel registry (nomad_tpu.analysis.jaxlint) -----------------------------
+#
+# Every ``traced_jit`` decoration registers a ``KernelEntry``: the
+# ORIGINAL un-jitted body, the jit kwargs (static_argnames included),
+# and — recorded at trace time, when the dynamic args are tracers
+# carrying avals and the static args are plain Python values — the
+# last-seen abstract call specs. The jaxpr analyzer re-traces each
+# registered kernel from these specs with ``jax.make_jaxpr`` and walks
+# the resulting ClosedJaxpr, so purity/dtype/determinism/fingerprint
+# invariants are checked against the *traced program*, not the Python
+# source.
+
+_KERNEL_SPECS_MAX = 8  # distinct abstract call specs kept per kernel
+
+
+class KernelEntry:
+    """One registered device kernel: identity, jit config, and the
+    abstract call specs seen so far (newest last)."""
+
+    __slots__ = ("name", "short", "fn", "jit_kwargs", "retrace_budget",
+                 "specs")
+
+    def __init__(self, name, short, fn, jit_kwargs, retrace_budget):
+        self.name = name
+        self.short = short
+        self.fn = fn
+        self.jit_kwargs = dict(jit_kwargs)
+        self.retrace_budget = retrace_budget
+        # sig string -> {"args": [spec...], "kwargs": {name: spec}};
+        # insertion-ordered, bounded to _KERNEL_SPECS_MAX (oldest evicted)
+        self.specs: dict[str, dict] = {}
+
+    @property
+    def static_argnames(self) -> tuple:
+        sa = self.jit_kwargs.get("static_argnames", ())
+        return (sa,) if isinstance(sa, str) else tuple(sa)
+
+    def last_spec(self):
+        """Newest recorded abstract call spec, or None if never traced."""
+        if not self.specs:
+            return None
+        return next(reversed(self.specs.values()))
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "short": self.short,
+            "module": self.fn.__module__,
+            "qualname": self.fn.__qualname__,
+            "static_argnames": list(self.static_argnames),
+            "retrace_budget": self.retrace_budget,
+            "specs": list(self.specs),
+        }
+
+
+_kernel_registry: dict[str, KernelEntry] = {}
+
+
+def _arg_spec(a):
+    """Abstract spec of one kernel argument, built at trace time.
+
+    Dynamic args are tracers -> ("aval", shape, dtype, weak_type);
+    static args are plain Python values -> ("static", value); anything
+    the analyzer cannot reconstruct -> ("opaque", type name)."""
+    aval = getattr(a, "aval", None)
+    if aval is not None and hasattr(aval, "shape"):
+        return ("aval", tuple(int(d) for d in aval.shape),
+                str(aval.dtype), bool(getattr(aval, "weak_type", False)))
+    if a is None or isinstance(a, (bool, int, float, str)):
+        return ("static", a)
+    if hasattr(a, "shape") and hasattr(a, "dtype"):  # concrete array
+        return ("aval", tuple(int(d) for d in a.shape),
+                str(a.dtype), False)
+    return ("opaque", type(a).__name__)
+
+
+def _record_kernel_spec(name: str, sig: str, args, kwargs) -> None:
+    """Record the abstract call spec under ``sig`` (called from the
+    trace-time counter, so once per XLA trace, never per dispatch)."""
+    entry = _kernel_registry.get(name)
+    if entry is None:
+        return
+    spec = {
+        "args": [_arg_spec(a) for a in args],
+        "kwargs": {k: _arg_spec(v) for k, v in sorted(kwargs.items())},
+    }
+    entry.specs.pop(sig, None)
+    entry.specs[sig] = spec
+    while len(entry.specs) > _KERNEL_SPECS_MAX:
+        entry.specs.pop(next(iter(entry.specs)))
+
+
+def kernel_registry() -> dict[str, KernelEntry]:
+    """Snapshot of the registered kernel fleet (name -> KernelEntry).
+    Entries are live objects — the analyzer reads, never mutates."""
+    with _trace_lock:
+        return dict(_kernel_registry)
+
 # -- kernel profiling (nomad_tpu.obs) ----------------------------------------
 #
 # Per-kernel call/compile accounting behind the same lock: every
@@ -161,10 +259,14 @@ def traced_jit(fn=None, *, trace_name=None, retrace_budget=None, **jit_kwargs):
     import jax
 
     name = trace_name or f"{fn.__module__}.{fn.__qualname__}"
+    short = name.rsplit(".", 1)[-1]
     with _trace_lock:
         _trace_counts.setdefault(name, 0)
         if retrace_budget is not None:
             _trace_budgets[name] = retrace_budget
+        _kernel_registry[name] = KernelEntry(
+            name, short, fn, jit_kwargs, retrace_budget
+        )
 
     @functools.wraps(fn)
     def _counted(*args, **kwargs):
@@ -172,10 +274,10 @@ def traced_jit(fn=None, *, trace_name=None, retrace_budget=None, **jit_kwargs):
         sig = _shape_sig(args, kwargs)
         with _trace_lock:
             _last_trace_shape[name] = sig
+            _record_kernel_spec(name, sig, args, kwargs)
         return fn(*args, **kwargs)
 
     jitted = jax.jit(_counted, **jit_kwargs)
-    short = name.rsplit(".", 1)[-1]
     watchdog_on = os.environ.get("NOMAD_TPU_KERNEL_WATCHDOG", "1") != "0"
 
     def _reference_call(args, kwargs):

@@ -25,6 +25,7 @@ from nomad_tpu.analysis.rules.admissiongate import AdmissionGateDiscipline
 from nomad_tpu.analysis.rules.algorithmseam import AlgorithmSeamDiscipline
 from nomad_tpu.analysis.rules.determinism import WallClockInScoringPath
 from nomad_tpu.analysis.rules.hostsync import HostSyncInJitKernel
+from nomad_tpu.analysis.rules.kernelseam import KernelSeamDiscipline
 from nomad_tpu.analysis.rules.laneowner import LaneOwnerDiscipline
 from nomad_tpu.analysis.rules.lockfields import LockDiscipline
 from nomad_tpu.analysis.rules.mergedsubmit import MergedSubmitDiscipline
@@ -869,6 +870,68 @@ class TestNTA016:
             ), rel
 
 
+class TestNTA017:
+    def test_bare_jit_call_triggers(self):
+        src = (
+            "import jax\n"
+            "def build():\n"
+            "    return jax.jit(lambda x: x + 1)\n"
+        )
+        fs = run(src, "nomad_tpu/device/foo.py", KernelSeamDiscipline)
+        assert rule_ids(fs) == ["NTA017"]
+        assert fs[0].symbol == "build"
+
+    def test_bare_jit_decorator_triggers(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def kernel(x):\n"
+            "    return x * 2\n"
+        )
+        fs = run(src, "nomad_tpu/scheduler/foo.py", KernelSeamDiscipline)
+        assert rule_ids(fs) == ["NTA017"]
+
+    def test_partial_jit_reference_triggers(self):
+        src = (
+            "import functools, jax\n"
+            "wrap = functools.partial(jax.jit, static_argnames=('k',))\n"
+        )
+        fs = run(src, "nomad_tpu/device/foo.py", KernelSeamDiscipline)
+        assert rule_ids(fs) == ["NTA017"]
+
+    def test_from_jax_import_jit_triggers(self):
+        src = "from jax import jit\n"
+        fs = run(src, "nomad_tpu/device/foo.py", KernelSeamDiscipline)
+        assert rule_ids(fs) == ["NTA017"]
+
+    def test_traced_jit_is_clean(self):
+        src = (
+            "from ..utils.backend import traced_jit\n"
+            "@traced_jit(static_argnames=('k',), retrace_budget=4)\n"
+            "def kernel(x, k):\n"
+            "    return x[:k]\n"
+        )
+        assert run(
+            src, "nomad_tpu/device/foo.py", KernelSeamDiscipline
+        ) == []
+
+    def test_backend_seam_is_exempt(self):
+        src = "import jax\njitted = jax.jit(lambda x: x)\n"
+        assert run(
+            src, "nomad_tpu/utils/backend.py", KernelSeamDiscipline
+        ) == []
+
+    def test_whole_package_at_head_is_clean(self):
+        """Every kernel compiles through traced_jit: zero bare jax.jit
+        to ratchet anywhere in nomad_tpu/."""
+        findings = [
+            f
+            for f in lint.run_lint(REPO_ROOT, rules=[KernelSeamDiscipline()])
+            if f.rule == "NTA017"
+        ]
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -939,7 +1002,7 @@ class TestBaselineRatchet:
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
             "NTA007", "NTA008", "NTA009", "NTA010", "NTA011", "NTA012",
-            "NTA013", "NTA014", "NTA015", "NTA016",
+            "NTA013", "NTA014", "NTA015", "NTA016", "NTA017",
         ]
 
 
@@ -955,8 +1018,10 @@ def run_cli(*argv, cwd=None):
 
 
 class TestCLI:
+    # --source-only keeps these subprocess tests off the jax import +
+    # fleet exercise; the combined default is covered in test_jaxlint.py
     def test_exit_zero_at_head(self):
-        r = run_cli()
+        r = run_cli("--source-only")
         assert r.returncode == 0, r.stdout + r.stderr
         assert "0 new finding(s)" in r.stdout
 
@@ -968,7 +1033,10 @@ class TestCLI:
         )
         empty = tmp_path / "baseline.json"
         empty.write_text('{"version": 1, "entries": []}\n')
-        r = run_cli("--root", str(tmp_path), "--baseline", str(empty))
+        r = run_cli(
+            "--source-only", "--root", str(tmp_path),
+            "--baseline", str(empty),
+        )
         assert r.returncode == 1, r.stdout + r.stderr
         assert "NTA001" in r.stdout
 
@@ -981,11 +1049,14 @@ class TestCLI:
         )
         baseline = tmp_path / "baseline.json"
         r = run_cli(
-            "--root", str(tmp_path), "--baseline", str(baseline),
-            "--fix-baseline",
+            "--source-only", "--root", str(tmp_path),
+            "--baseline", str(baseline), "--fix-baseline",
         )
         assert r.returncode == 0, r.stdout + r.stderr
-        r = run_cli("--root", str(tmp_path), "--baseline", str(baseline))
+        r = run_cli(
+            "--source-only", "--root", str(tmp_path),
+            "--baseline", str(baseline),
+        )
         assert r.returncode == 0
         assert "1 ratcheted" in r.stdout
 
@@ -993,9 +1064,11 @@ class TestCLI:
         assert run_cli("--rules", "NTA999").returncode == 2
 
     def test_json_output_parses(self):
-        r = run_cli("--json")
+        r = run_cli("--source-only", "--json")
         data = json.loads(r.stdout)
-        assert data["new"] == [] and data["ratcheted"] >= 0
+        assert data["source"]["new"] == []
+        assert data["source"]["ratcheted"] >= 0
+        assert data["kernels"] is None
 
 
 # -- runtime race detector --------------------------------------------------
