@@ -752,6 +752,17 @@ def bench_soak(argv: list, batch_workers: int) -> dict:
     p.add_argument("--sat-probe-seconds", type=float, default=2.0)
     p.add_argument("--sat-nodes", type=int, default=200)
     p.add_argument(
+        "--calib-artifact", type=str, default="CALIB_r01.json",
+        help="where --saturation writes the calibration probe artifact "
+        "(loaded by ServerConfig(calibration_artifact=...) to derive "
+        "admission thresholds from the measured rate; '' disables)",
+    )
+    p.add_argument(
+        "--calib-from", type=str, default=None,
+        help="load a previously written probe artifact so this soak "
+        "admits under the probe-derived thresholds (source: probe)",
+    )
+    p.add_argument(
         "--overload", action="store_true",
         help="admission-control acceptance run: find the saturation "
         "rate, then replay a burst soak spiking past it and demand the "
@@ -799,8 +810,21 @@ def bench_soak(argv: list, batch_workers: int) -> dict:
         spike_start=args.spike_start,
         spike_seconds=args.spike_seconds,
         priority_mix=mix,
+        calibration_artifact=args.calib_from,
     )
-    return run.to_dict()
+    d = run.to_dict()
+    if run.saturation_rate is not None and args.calib_artifact:
+        from nomad_tpu.obs.calibrate import write_probe_artifact
+
+        write_probe_artifact(
+            args.calib_artifact,
+            rate_per_s=run.saturation_rate,
+            seed=args.seed,
+            nodes=args.sat_nodes,
+            probe_seconds=args.sat_probe_seconds,
+        )
+        d["calib_artifact"] = args.calib_artifact
+    return d
 
 
 def _bench_soak_overload(args, batch_workers: int, mix) -> dict:
@@ -1028,6 +1052,47 @@ def main():
                     f"({n_nodes} nodes, {n_jobs} jobs x {count})",
                     "value": d["ab"]["score_delta"],
                     "unit": "score",
+                    "vs_baseline": 0.0,
+                    "platform": jax.devices()[0].platform,
+                    "fallback": fallback,
+                    "detail": d,
+                },
+                sort_keys=True,
+            )
+        )
+        if not d["ok"]:
+            sys.exit(1)
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "calib":
+        # calibration A/B: declared vs learned throughputs on one seeded
+        # mixed fleet. The estimator learns per-(device class × job
+        # profile) rates from synthetic execute traces fed through the
+        # real flight-recorder fan-out, then places *blind* asks (no
+        # declared throughputs). Canonical, seeded, byte-reproducible
+        # JSON; gates (exit 1) on learned-mode quality landing within
+        # tolerance of declared-mode, declared mode staying
+        # byte-identical with an estimator attached, and zero added
+        # jaxpr retraces (obs/calibrate.py).
+        fallback = _ensure_live_backend()
+        import jax
+
+        from nomad_tpu.obs.calibrate import run_calib_ab
+
+        n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+        n_jobs = int(sys.argv[3]) if len(sys.argv) > 3 else 12
+        count = int(sys.argv[4]) if len(sys.argv) > 4 else 25
+        d = run_calib_ab(
+            n_nodes=n_nodes, n_jobs=n_jobs, count_per_job=count, seed=42
+        )
+        d["mesh"] = mesh_block(n_nodes)
+        d["kernel_fingerprints"] = kernel_fingerprints_block()
+        print(
+            json.dumps(
+                {
+                    "metric": "learned-throughput maxmin worst-share gain "
+                    f"({n_nodes} nodes, {n_jobs} jobs x {count})",
+                    "value": d["ab"]["learned"]["maxmin_worst_share_delta"],
+                    "unit": "share",
                     "vs_baseline": 0.0,
                     "platform": jax.devices()[0].platform,
                     "fallback": fallback,

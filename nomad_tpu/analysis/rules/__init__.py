@@ -19,6 +19,7 @@ from .scoredump import ScoreDumpDiscipline  # noqa: E402
 from .shardingseam import ShardingSeamDiscipline  # noqa: E402
 from .solverseam import SolverSeamDiscipline  # noqa: E402
 from .kernelseam import KernelSeamDiscipline  # noqa: E402
+from .provenance import ConstantProvenanceDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -38,6 +39,7 @@ REGISTRY = [
     ShardingSeamDiscipline,  # NTA015
     SolverSeamDiscipline,  # NTA016
     KernelSeamDiscipline,  # NTA017
+    ConstantProvenanceDiscipline,  # NTA018
 ]
 
 __all__ = ["REGISTRY"]

@@ -1,14 +1,17 @@
-"""NTA008 — broker/server time flows through an injectable clock.
+"""NTA008 — broker/server/obs time flows through an injectable clock.
 
 The chaos plane's clock-skew faults (nomad_tpu.chaos) only reach a
 decision if that decision reads time through the injected clock: the
 broker's unack-redelivery deadline, its delayed-eval heap, and the
 heartbeater's TTL expiry are exactly the paths a skewed clock is meant
 to stress. A bare ``time.time()`` or ``time.sleep()`` in
-``nomad_tpu/broker/`` or ``nomad_tpu/server/`` is a decision the fault
-plane (and any deterministic replay) cannot steer, so it is banned; use
-the ``clock=`` seam (``self._clock()``) the way EvalBroker and
-NodeHeartbeater do, or take a ``sleep=`` callable.
+``nomad_tpu/broker/``, ``nomad_tpu/server/``, or ``nomad_tpu/obs/`` is
+a decision the fault plane (and any deterministic replay) cannot steer,
+so it is banned; use the ``clock=`` seam (``self._clock()``) the way
+EvalBroker and NodeHeartbeater do, or take a ``sleep=`` callable. The
+obs scope keeps the SLO collector and throughput-estimator windows
+replayable under FakeClock; ``obs/loadgen.py`` is exempt — wall-clock
+pacing of open-loop arrivals is the point there.
 
 ``time.monotonic``/``time.perf_counter`` for *measuring* (metrics
 spans, wait-loop budgets in test helpers) stay legal — only ``time``
@@ -75,11 +78,14 @@ class _Visitor(ScopedVisitor):
 
 class BareWallClockInBrokerServer(Rule):
     id = "NTA008"
-    title = "broker/server time must flow through an injectable clock"
+    title = "broker/server/obs time must flow through an injectable clock"
 
     def applies_to(self, relpath: str) -> bool:
+        if relpath == "nomad_tpu/obs/loadgen.py":
+            # wall-clock pacing of open-loop arrivals is intentional
+            return False
         return relpath.startswith(
-            ("nomad_tpu/broker/", "nomad_tpu/server/")
+            ("nomad_tpu/broker/", "nomad_tpu/server/", "nomad_tpu/obs/")
         )
 
     def check(self, tree, source, relpath) -> list[Finding]:

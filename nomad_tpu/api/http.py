@@ -253,6 +253,12 @@ class HTTPAgent:
                 re.compile(r"^/v1/agent/slo$"),
                 self.handle_agent_slo,
             ),
+            (
+                # calibration surface: constant provenance + learned
+                # throughput cells
+                re.compile(r"^/v1/agent/calibration$"),
+                self.handle_agent_calibration,
+            ),
             (re.compile(r"^/v1/status/leader$"), self.handle_leader),
             (re.compile(r"^/v1/metrics$"), self.handle_metrics),
             (re.compile(r"^/v1/acl/bootstrap$"), self.handle_acl_bootstrap),
@@ -984,6 +990,9 @@ class HTTPAgent:
                 "placement_explanations": getattr(
                     cfg, "placement_explanations", True
                 ),
+                "throughput_source": getattr(
+                    cfg, "throughput_source", "declared"
+                ),
             }
         if method in ("POST", "PUT"):
             self._enforce(query, "operator_write")
@@ -1009,6 +1018,10 @@ class HTTPAgent:
                     "placement_explanations",
                     getattr(cfg, "placement_explanations", True),
                 ),
+                throughput_source=body.get(
+                    "throughput_source",
+                    getattr(cfg, "throughput_source", "declared"),
+                ),
             )
             from ..scheduler import algorithms as sched_algorithms
 
@@ -1017,6 +1030,14 @@ class HTTPAgent:
                     400,
                     "scheduler_algorithm must be one of: "
                     + "|".join(sched_algorithms.available()),
+                )
+            from ..scheduler.hetero import THROUGHPUT_SOURCES
+
+            if new_cfg.throughput_source not in THROUGHPUT_SOURCES:
+                raise APIError(
+                    400,
+                    "throughput_source must be one of: "
+                    + "|".join(THROUGHPUT_SOURCES),
                 )
             self.server.raft_apply(MsgType.SCHED_CONFIG, {"config": new_cfg})
             return {"updated": True}
@@ -1679,6 +1700,29 @@ class HTTPAgent:
                 or k == "nomad.plan.cross_lane_handoffs"
                 or k == "nomad.broker.nack_redelivery_delayed"
             },
+        }
+
+    def handle_agent_calibration(self, method, body, query):
+        """/v1/agent/calibration — the calibration plane: every
+        operational constant with its provenance (default/probe/
+        learned), the loaded probe artifact if any, the throughput
+        estimator's learned cells, and the active throughput source
+        (``nomad-tpu calibrate status|report`` reads this)."""
+        self._enforce(query, "agent_read")
+        srv = self.server
+        cfg = srv.store.scheduler_config()
+        table = getattr(srv, "calibration", None)
+        est = getattr(srv, "throughput_estimator", None)
+        if table is None:
+            from ..obs.calibrate import global_table as table
+        if est is None:
+            from ..obs.calibrate import global_estimator as est
+        return {
+            "table": table.snapshot(),
+            "estimator": est.snapshot(),
+            "throughput_source": getattr(
+                cfg, "throughput_source", "declared"
+            ),
         }
 
     def handle_agent_slo(self, method, body, query):

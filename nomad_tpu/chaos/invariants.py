@@ -68,6 +68,14 @@ true no matter which faults fired:
     pass ledger balances — and no pass ever committed usage beyond a
     node's capacity (``nomad.cp.capacity_violations`` stays 0), even
     through ``cp.round_perturb`` price-perturbation windows.
+``calibration_sanity``
+    the calibration plane (obs/calibrate.py) degrades to declared,
+    never to garbage: every throughput-estimator cell is finite and
+    positive, a cell below the sample floor reports ``source: default``
+    (and only then), a learned read stays inside the clamp band of its
+    anchor, and every calibration-table constant is finite with a known
+    provenance source — including through ``calib.telemetry_drop``
+    starvation windows.
 """
 
 from __future__ import annotations
@@ -95,6 +103,7 @@ INVARIANTS = (
     "class_capacity",
     "shard_consistency",
     "cp_assignment_conservation",
+    "calibration_sanity",
 )
 
 
@@ -496,6 +505,69 @@ def check_cluster(
             for detail in mismatches:
                 report._fail("shard_consistency", "device_cache", detail)
             report.info["device_cache"] = cache.device_counters()
+
+    # -- calibration_sanity ------------------------------------------------
+    # Law 14: estimation degrades to declared, never to garbage. Checked
+    # whenever the server carries a calibration plane (estimator/table);
+    # telemetry-drop starvation must leave every cell honest.
+    import math as _math
+
+    est = getattr(server, "throughput_estimator", None)
+    table = getattr(server, "calibration", None)
+    if est is not None or table is not None:
+        report.checked["calibration_sanity"] = True
+    if est is not None:
+        esnap = est.snapshot()
+        floor = esnap["sample_floor"]
+        band = esnap["clamp_band"]
+        for key, cell in esnap["cells"].items():
+            ema = cell["ema"]
+            if not (_math.isfinite(ema) and ema > 0):
+                report._fail(
+                    "calibration_sanity",
+                    f"cell:{key}",
+                    f"non-finite/non-positive ema {ema!r}",
+                )
+            want = "default" if cell["samples"] < floor else "learned"
+            if cell["source"] != want:
+                report._fail(
+                    "calibration_sanity",
+                    f"cell:{key}",
+                    f"samples={cell['samples']} (floor {floor}) but "
+                    f"source={cell['source']!r}, want {want!r}",
+                )
+            value, source = est.value(
+                cell["device_class"], cell["profile"], declared=1.0
+            )
+            if source == "learned" and not (
+                1.0 / band <= value <= band
+            ):
+                report._fail(
+                    "calibration_sanity",
+                    f"cell:{key}",
+                    f"learned value {value} outside clamp band "
+                    f"[{1.0 / band}, {band}] of unit anchor",
+                )
+        report.info["calibration_estimator"] = {
+            k: esnap[k]
+            for k in ("cell_count", "learned_cells", "samples", "dropped")
+        }
+    if table is not None:
+        tsnap = table.snapshot()
+        for name, entry in tsnap["constants"].items():
+            if not _math.isfinite(entry["value"]):
+                report._fail(
+                    "calibration_sanity",
+                    f"constant:{name}",
+                    f"non-finite value {entry['value']!r}",
+                )
+            if entry["source"] not in ("default", "probe", "learned"):
+                report._fail(
+                    "calibration_sanity",
+                    f"constant:{name}",
+                    f"unknown provenance source {entry['source']!r}",
+                )
+        report.info["calibration_by_source"] = tsnap["by_source"]
 
     # context for the human-facing dump
     from ..resilience.breaker import snapshot_all

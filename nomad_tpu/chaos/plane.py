@@ -88,6 +88,11 @@ SITES: dict[str, tuple[str, ...]] = {
     # exactly one of placed/deferred/failed and capacity is never
     # exceeded post-round
     "cp.round_perturb": ("perturb",),
+    # calibration plane (obs/calibrate.py): drop estimator input samples
+    # before they reach their cell — starved cells must keep reporting
+    # source: default and answer the declared anchor, never a garbage
+    # estimate (invariant law 14)
+    "calib.telemetry_drop": ("drop",),
 }
 
 FAULT_KINDS = (
@@ -120,6 +125,8 @@ _HORIZON = {
     "mesh.shard_refresh_drop": (0.125, 2),
     # hit once per joint CP placement pass, not per workload op
     "cp.round_perturb": (0.125, 2),
+    # hit once per estimator input sample (span fan-out rate)
+    "calib.telemetry_drop": (1.0, 8),
 }
 
 

@@ -968,6 +968,87 @@ def cmd_slo_report(args) -> int:
     return 1
 
 
+def cmd_calibrate_status(args) -> int:
+    """`nomad-tpu calibrate status` — one-screen calibration summary
+    from /v1/agent/calibration: constants by provenance, the loaded
+    probe artifact, learned estimator cells, throughput source."""
+    c = _client(args)
+    try:
+        out = c._request("GET", "/v1/agent/calibration")
+    except APIException as e:
+        return _fail(str(e))
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    table = out.get("table", {})
+    by_source = table.get("by_source", {})
+    print(
+        f"constants: {len(table.get('constants', {}))} "
+        f"(default={by_source.get('default', 0)} "
+        f"probe={by_source.get('probe', 0)} "
+        f"learned={by_source.get('learned', 0)})"
+    )
+    probe = table.get("probe")
+    if probe:
+        print(
+            f"probe artifact: rate={probe.get('rate_evals_per_s', 0.0):g}/s "
+            f"seed={probe.get('seed', 0)} nodes={probe.get('nodes', 0)} "
+            f"window={probe.get('probe_seconds', 0.0):g}s"
+        )
+    else:
+        print("probe artifact: none loaded")
+    est = out.get("estimator", {})
+    print(
+        f"estimator: cells={est.get('cell_count', 0)} "
+        f"learned={est.get('learned_cells', 0)} "
+        f"samples={est.get('samples', 0)} "
+        f"dropped={est.get('dropped', 0)}"
+    )
+    print(f"throughput source: {out.get('throughput_source', 'declared')}")
+    return 0
+
+
+def cmd_calibrate_report(args) -> int:
+    """`nomad-tpu calibrate report` — the full calibration plane: every
+    constant with value/source/provenance and every learned
+    per-(device class × job profile) throughput cell."""
+    c = _client(args)
+    try:
+        out = c._request("GET", "/v1/agent/calibration")
+    except APIException as e:
+        return _fail(str(e))
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    constants = (out.get("table") or {}).get("constants", {})
+    print(f"{'constant':<36} {'value':>12} {'source':<8} samples window")
+    for name in sorted(constants):
+        e = constants[name]
+        print(
+            f"{name:<36} {e.get('value', 0.0):>12g} "
+            f"{e.get('source', '?'):<8} "
+            f"{e.get('samples', 0):>7} {e.get('window') or '-'}"
+        )
+    cells = (out.get("estimator") or {}).get("cells", {})
+    if cells:
+        print(
+            f"\n{'device class × profile':<36} {'ema':>10} "
+            f"{'p50':>10} {'conf':>6} samples source"
+        )
+        for key in sorted(cells):
+            cell = cells[key]
+            print(
+                f"{key:<36} {cell.get('ema', 0.0):>10.3f} "
+                f"{cell.get('p50', 0.0):>10.3f} "
+                f"{cell.get('confidence', 0.0):>6.2f} "
+                f"{cell.get('samples', 0):>7} {cell.get('source', '?')}"
+            )
+    else:
+        print("\nno learned throughput cells yet")
+    print(f"\nthroughput source: {out.get('throughput_source', 'declared')}")
+    return 0
+
+
 def cmd_scaling_policies(args) -> int:
     """`nomad scaling policy list` (command/scaling_policy_list.go)."""
     c = _client(args)
@@ -1593,6 +1674,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="override the placement-latency p99 target for the verdict",
     )
     srep.set_defaults(fn=cmd_slo_report)
+
+    calib = sub.add_parser(
+        "calibrate", help="calibration plane: constant provenance, "
+        "learned throughputs"
+    ).add_subparsers(dest="calib_cmd", required=True)
+    cstat = calib.add_parser("status")
+    cstat.add_argument("-json", action="store_true")
+    cstat.set_defaults(fn=cmd_calibrate_status)
+    crep = calib.add_parser("report")
+    crep.add_argument("-json", action="store_true")
+    crep.set_defaults(fn=cmd_calibrate_report)
 
     ver = sub.add_parser("version", help="show version")
     ver.set_defaults(fn=cmd_version)

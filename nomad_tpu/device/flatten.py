@@ -340,6 +340,10 @@ class GroupAsk:
     # binpack/spread kernels never read it at all (bit-identity).
     throughputs: np.ndarray | None = None  # f32[N]
     has_throughputs: bool = False
+    # Calibration profile key (obs/calibrate.py): the job-profile axis of
+    # the ThroughputEstimator's (device_class × profile) matrix. Empty =
+    # not calibratable; only the hetero kernel's learned mode reads it.
+    profile: str = ""
     # Job priority (structs/job.py, 0-100). The CP dispatcher's joint
     # pass resolves contested nodes by tier before score (scheduler/
     # cp.py); the per-group kernels never read it.
@@ -370,6 +374,23 @@ def job_throughput_vector(
     if bool(np.all(vec == np.float32(1.0))):
         return None, False
     return vec, True
+
+
+def job_profile_key(job) -> str:
+    """Stable calibration-profile key for a job: an explicit
+    ``calibration_profile`` wins; otherwise the declared throughput map
+    itself (sorted, value-normalized) names the profile, so jobs with the
+    same declared shape share telemetry cells. Empty = no profile —
+    learned mode leaves the job on its declared/all-ones coefficients."""
+    explicit = getattr(job, "calibration_profile", "") or ""
+    if explicit:
+        return str(explicit)
+    throughputs = getattr(job, "throughputs", None) or {}
+    if not throughputs:
+        return ""
+    return "tp:" + ",".join(
+        f"{k}={float(v):g}" for k, v in sorted(throughputs.items())
+    )
 
 
 def _eligibility_for_group(
@@ -833,5 +854,6 @@ def flatten_group_ask(
         filter_stats=filter_stats,
         throughputs=throughputs,
         has_throughputs=has_tp,
+        profile=job_profile_key(job),
         priority=job.priority,
     )

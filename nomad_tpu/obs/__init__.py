@@ -16,8 +16,25 @@ utils/backend.py):
   replays a seeded Poisson traffic schedule against a live cluster and
   reports against declared ``SloTargets`` (``/v1/agent/slo``,
   ``nomad-tpu slo report``, ``bench.py soak``).
+- **Calibration plane**: ``CalibrationTable`` gives every operational
+  constant a provenance (``default``/``probe``/``learned``);
+  ``ThroughputEstimator`` learns per-(device class × job profile)
+  throughputs from the recorder's trace feed (``/v1/agent/calibration``,
+  ``nomad-tpu calibrate``, ``bench.py calib``).
 """
 
+# calibrate imports before loadgen: loadgen pulls in the server stack,
+# which lazily re-enters obs — calibrate must already be importable
+from .calibrate import (
+    CalibrationTable,
+    ThroughputEstimator,
+    calibration_overview,
+    derive_admission_thresholds,
+    global_estimator,
+    global_table,
+    run_calib_ab,
+    write_probe_artifact,
+)
 from .loadgen import SoakRun, build_schedule, run_soak, saturation_search
 from .recorder import (
     FlightRecorder,
@@ -37,6 +54,7 @@ from .slo import (
 from .trace import Span, SpanContext, Tracer, global_tracer
 
 __all__ = [
+    "CalibrationTable",
     "FlightRecorder",
     "SLO_SCHEMA",
     "SloCollector",
@@ -44,16 +62,23 @@ __all__ = [
     "SoakRun",
     "Span",
     "SpanContext",
+    "ThroughputEstimator",
     "Tracer",
     "build_report",
     "build_schedule",
+    "calibration_overview",
+    "derive_admission_thresholds",
     "flight_recorder",
+    "global_estimator",
+    "global_table",
     "global_tracer",
     "live_report",
     "phase_breakdown",
     "render_trace",
+    "run_calib_ab",
     "run_soak",
     "saturation_search",
     "slo_schema_of",
     "trace_latencies",
+    "write_probe_artifact",
 ]

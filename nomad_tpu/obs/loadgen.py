@@ -405,6 +405,7 @@ def run_soak(
     spike_seconds: float = 0.0,
     priority_mix: Optional[dict] = None,
     admission_overrides: Optional[dict] = None,
+    calibration_artifact: Optional[str] = None,
 ) -> SoakRun:
     """One full soak cycle: boot, seed fleet, replay the schedule on
     the wall clock, quiesce, check invariants, build the SLO report."""
@@ -428,6 +429,9 @@ def run_soak(
             # by the schedule's down/up events instead
             heartbeat_ttl=3600.0,
             admission_overrides=admission_overrides,
+            # probe-derived thresholds (bench.py soak --saturation
+            # writes the artifact; this run admits under them)
+            calibration_artifact=calibration_artifact,
         )
     )
     broker = server.eval_broker

@@ -31,8 +31,11 @@ class FlightRecorder:
         self,
         capacity: int = DEFAULT_CAPACITY,
         error_capacity: int = DEFAULT_ERROR_CAPACITY,
+        clock=None,
     ):
         self.capacity = capacity
+        # injectable wall clock for error-event stamps (NTA008)
+        self._clock = clock if clock is not None else time.time
         self._lock = threading.Lock()
         # eval_id → trace dict, insertion-ordered: oldest first, evicted
         # first; a re-processed eval re-records and moves to the tail
@@ -143,7 +146,7 @@ class FlightRecorder:
             self.errors_total += 1
             self._errors.append(
                 {
-                    "at_unix": time.time(),
+                    "at_unix": self._clock(),
                     "component": component,
                     "error": error,
                     "eval_id": eval_id,

@@ -78,6 +78,12 @@ SLO_SCHEMA = tuple(sorted(
     + [f"counters.{k}" for k in sorted(REPORT_COUNTERS)]
     + ["counters.swallowed_errors"]
     + [
+        "calibration.constants",
+        "calibration.probe_sourced",
+        "calibration.learned_cells",
+        "calibration.estimator_samples",
+    ]
+    + [
         "ring_coverage.traces_recorded",
         "ring_coverage.traces_evicted",
         "ring_coverage.coverage",
@@ -309,6 +315,19 @@ class SloCollector:
         with self._lock:
             self.queue_ring.observe(now, float(depth + plan_depth))
 
+    def _calibration_block(self) -> dict:
+        """Calibration-plane summary for the report: how many constants
+        are probe-sourced and how much the throughput estimator has
+        learned. Reads the attached server's table/estimator; a
+        server-less collector reports the process globals (the shape —
+        four scalars — is pinned either way)."""
+        from .calibrate import calibration_overview
+
+        return calibration_overview(
+            table=getattr(self._server, "calibration", None),
+            estimator=getattr(self._server, "throughput_estimator", None),
+        )
+
     # -- report ------------------------------------------------------------
     def measured(self) -> dict:
         """The ``slo`` block: everything measured since the collector
@@ -365,6 +384,7 @@ class SloCollector:
                 "completion_rate_per_s": round(completions / span, 3),
             },
             "counters": ctr,
+            "calibration": self._calibration_block(),
             "ring_coverage": {
                 "traces_recorded": recorded,
                 "traces_evicted": evicted,

@@ -77,13 +77,17 @@ class Span:
         name: str,
         parent_id: Optional[int] = None,
         tags: Optional[dict] = None,
+        clock=None,
     ):
         self.trace_id = trace_id
         self.span_id = next(_ids)
         self.parent_id = parent_id
         self.name = name
         self.tags = dict(tags) if tags else {}
-        self.start_unix = time.time()
+        # injectable wall clock (NTA008): the tracer threads its own so
+        # estimator/SLO windows over span streams replay under FakeClock
+        wall = clock if clock is not None else time.time
+        self.start_unix = wall()
         self.duration_ms: Optional[float] = None
         self.status = "ok"
         self._t0 = time.perf_counter()
@@ -119,13 +123,15 @@ class _Trace:
 
 
 class Tracer:
-    def __init__(self, recorder=None):
+    def __init__(self, recorder=None, clock=None):
         self._lock = threading.Lock()
         self._active: dict[str, _Trace] = {}
         self._tls = threading.local()
         self._enabled = True
         self._dropped = 0
         self.recorder = recorder
+        # wall clock for span start stamps (injectable for FakeClock tests)
+        self._clock = clock if clock is not None else time.time
 
     # -- enable switch -----------------------------------------------------
     @property
@@ -159,7 +165,10 @@ class Tracer:
         with self._lock:
             tr = self._active.get(trace_id)
             if tr is None:
-                tr = _Trace(trace_id, Span(trace_id, name, tags=tags))
+                tr = _Trace(
+                    trace_id,
+                    Span(trace_id, name, tags=tags, clock=self._clock),
+                )
                 self._active[trace_id] = tr
             elif tags:
                 tr.root.tags.update(tags)
@@ -302,7 +311,10 @@ class Tracer:
             with self._lock:
                 self._dropped += 1
             return None
-        sp = Span(tr.trace_id, name, parent_id=parent.span_id, tags=tags)
+        sp = Span(
+            tr.trace_id, name, parent_id=parent.span_id, tags=tags,
+            clock=self._clock,
+        )
         tr.spans.append(sp)
         self._stack().append(sp)
         return sp
@@ -327,7 +339,7 @@ class Tracer:
                 self._dropped += 1
             return None
         pid = parent.span_id if parent is not None else tr.root.span_id
-        sp = Span(trace_id, name, parent_id=pid, tags=tags)
+        sp = Span(trace_id, name, parent_id=pid, tags=tags, clock=self._clock)
         sp.start_unix -= duration_s
         sp.duration_ms = duration_s * 1000.0
         tr.spans.append(sp)

@@ -220,20 +220,40 @@ _TUNABLES = (
     "backoff_base",
     "backoff_cap",
 )
+# None = "no env pin": the deadline defaults then come from the
+# calibration table (obs/calibrate.py, resilience.execute_deadline_s /
+# compile_deadline_s) so they carry provenance. Env vars keep
+# precedence, and configure() overrides both.
 _DEFAULTS: dict = {
     "failure_threshold": _env_int("NOMAD_TPU_BREAKER_THRESHOLD", 3),
-    "execute_deadline": _env_float("NOMAD_TPU_KERNEL_EXECUTE_DEADLINE", 5.0),
-    "compile_deadline": _env_float("NOMAD_TPU_KERNEL_COMPILE_DEADLINE", 60.0),
+    "execute_deadline": _env_float("NOMAD_TPU_KERNEL_EXECUTE_DEADLINE", None),
+    "compile_deadline": _env_float("NOMAD_TPU_KERNEL_COMPILE_DEADLINE", None),
     "backoff_base": _env_float("NOMAD_TPU_BREAKER_BACKOFF", 1.0),
     "backoff_cap": _env_float("NOMAD_TPU_BREAKER_BACKOFF_CAP", 30.0),
 }
+
+
+def _resolved_defaults() -> dict:
+    """Concrete constructor kwargs: env-pinned / configure()d values win;
+    an unpinned deadline reads the calibration table at construction
+    time (lazy import — same cycle workaround as server/admission.py)."""
+    out = dict(_DEFAULTS)
+    if out["execute_deadline"] is None or out["compile_deadline"] is None:
+        from ..obs.calibrate import global_table
+
+        tbl = global_table.breaker_defaults()
+        if out["execute_deadline"] is None:
+            out["execute_deadline"] = tbl["execute_deadline"]
+        if out["compile_deadline"] is None:
+            out["compile_deadline"] = tbl["compile_deadline"]
+    return out
 
 
 def breaker_for(name: str) -> CircuitBreaker:
     with _REG_LOCK:
         br = _BREAKERS.get(name)
         if br is None:
-            br = CircuitBreaker(name, **_DEFAULTS)
+            br = CircuitBreaker(name, **_resolved_defaults())
             _BREAKERS[name] = br
         return br
 
@@ -259,9 +279,10 @@ def configure(**overrides) -> dict:
             if key not in _DEFAULTS:
                 raise TypeError(f"unknown breaker tunable: {key}")
             _DEFAULTS[key] = value
+        resolved = _resolved_defaults()
         for br in _BREAKERS.values():
             for key in _TUNABLES:
-                setattr(br, key, _DEFAULTS[key])
+                setattr(br, key, resolved[key])
         return prev
 
 

@@ -54,6 +54,22 @@ class FailedTGAlloc:
         self.metric = metric
 
 
+def wire_throughput_source(kernel, cfg) -> None:
+    """Calibration seam: in learned mode the hetero kernel reads the
+    process-global ThroughputEstimator instead of declared jobspec
+    coefficients. Same Python-level gating discipline as explain —
+    "declared" (the default, and every non-hetero kernel) touches
+    nothing, so the pre-calibration path stays bit-identical."""
+    if (
+        getattr(cfg, "throughput_source", "declared") == "learned"
+        and hasattr(kernel, "throughput_source")
+    ):
+        from ..obs.calibrate import global_estimator
+
+        kernel.throughput_source = "learned"
+        kernel.estimator = global_estimator
+
+
 def tainted_nodes(snapshot, allocs) -> dict:
     """Map node id → Node for nodes that are down or draining
     (scheduler/util.go:354-378). Nodes missing from state count as tainted
@@ -136,6 +152,7 @@ class GenericScheduler:
         cfg = self.snapshot.scheduler_config()
         self.scheduler_config = cfg
         self.kernel = make_kernel(cfg.scheduler_algorithm)
+        wire_throughput_source(self.kernel, cfg)
         self._explain = bool(getattr(cfg, "placement_explanations", True))
 
         success = False
@@ -248,6 +265,7 @@ class GenericScheduler:
         cfg = self.snapshot.scheduler_config()
         self.scheduler_config = cfg
         self.kernel = make_kernel(cfg.scheduler_algorithm)
+        wire_throughput_source(self.kernel, cfg)
         self._explain = bool(getattr(cfg, "placement_explanations", True))
         placements = self._start_attempt()
         if not placements or self.job is None:

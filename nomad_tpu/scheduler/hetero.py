@@ -79,6 +79,12 @@ DEVICE_CLASS_COSTS: dict[str, float] = {
 
 _EPS = np.float32(1e-9)
 
+# Where the policies' throughput matrix comes from (SchedulerConfiguration
+# knob; obs/calibrate.py owns "learned"). Declared is the PR-9 behavior.
+THROUGHPUT_DECLARED = "declared"
+THROUGHPUT_LEARNED = "learned"
+THROUGHPUT_SOURCES = (THROUGHPUT_DECLARED, THROUGHPUT_LEARNED)
+
 
 def class_cost_vector(ct, costs: dict | None = None) -> np.ndarray:
     """Per-node cost f32[N] from the fleet's device-class column."""
@@ -303,16 +309,33 @@ class HeteroPlacementKernel:
     device-slot caps) delegates to the base binpack kernel so behavior
     degrades to exactly the pre-heterogeneity placement."""
 
-    def __init__(self, policy: str, force_scan: bool = False, mesh=None):
+    def __init__(
+        self,
+        policy: str,
+        force_scan: bool = False,
+        mesh=None,
+        throughput_source: str = "declared",
+        estimator=None,
+    ):
         from ..device.score import PlacementKernel
 
         if policy not in POLICY_IDS:
             raise ValueError(f"unknown hetero policy {policy!r}")
+        if throughput_source not in THROUGHPUT_SOURCES:
+            raise ValueError(
+                f"unknown throughput source {throughput_source!r}"
+            )
         self.policy = policy
         self.policy_id = POLICY_IDS[policy]
         self.algorithm_spread = False
         self.force_scan = force_scan
         self._mesh = mesh
+        # calibration seam (obs/calibrate.py): in learned mode the batch's
+        # declared tp matrix is substituted — same shape and dtype, pure
+        # Python, so the jitted kernel never retraces. Declared mode never
+        # consults the estimator at all (bit-identity gate).
+        self.throughput_source = throughput_source
+        self.estimator = estimator
         self._base = PlacementKernel("binpack", force_scan, mesh=mesh)
 
     def mesh_cfg(self):
@@ -320,10 +343,22 @@ class HeteroPlacementKernel:
 
         return self._mesh if self._mesh is not None else get_mesh()
 
+    def _learned(self) -> bool:
+        return (
+            self.throughput_source == THROUGHPUT_LEARNED
+            and self.estimator is not None
+        )
+
     def _hetero_eligible(self, cluster, asks: list) -> bool:
         if not getattr(cluster, "has_device_classes", False):
             return False
-        if not any(a.has_throughputs for a in asks):
+        # learned mode qualifies on profile keys alone: the whole point
+        # is running the policies on jobs whose declared coefficients are
+        # absent (or hidden), estimated from telemetry instead
+        if not any(a.has_throughputs for a in asks) and not (
+            self._learned()
+            and any(getattr(a, "profile", "") for a in asks)
+        ):
             return False
         # coupled features stay on the battle-tested base scan
         return not any(
@@ -342,6 +377,18 @@ class HeteroPlacementKernel:
         batch = build_hetero_batch(
             cluster, asks, used_override=kwargs.get("used_override")
         )
+        if self._learned():
+            # Python-level substitution before device upload: learned
+            # per-(class × profile) values replace the declared matrix
+            # cell-wise (declared anchors stay the fallback below the
+            # sample floor), shapes/dtypes unchanged — zero new traces.
+            from ..obs.calibrate import learned_tp_matrix
+
+            batch.tp = learned_tp_matrix(
+                self.estimator, cluster, asks, batch.tp
+            )
+            elig_tp = np.where(batch.eligible, batch.tp, np.float32(0.0))
+            batch.tpmax = elig_tp.max(axis=1).astype(np.float32)
         from ..utils.backend import shard_put
 
         cfg = self.mesh_cfg()

@@ -212,35 +212,48 @@ class HistWindow:
         return win.count, win.percentile(0.99) * 1000.0
 
 
-# Defaults sized so NORMAL is byte-identical to the pre-admission repo
-# at every existing test/soak scale: brownout needs a ~512-deep active
-# backlog or a multi-second p99 with real sample volume behind it.
-_DEFAULTS: dict = {
-    "brownout_backlog": 512.0,
-    "shed_backlog": 2048.0,
-    "brownout_p99_ms": 2500.0,
-    "shed_p99_ms": 10000.0,
-    "exit_fraction": 0.5,
-    "imbalance_ratio": 1.5,
-    "imbalance_min_backlog": 64.0,
-    "min_p99_samples": 16,
-    "dwell_s": 2.0,
-    "reeval_interval_s": 0.25,
-    "retry_after_s": 2.0,
-    "defer_delay_s": 1.0,
-    "flap_window_s": 0.4,
+# The controller's tuned constants live in the calibration table
+# (obs/calibrate.py, ``admission.*`` namespace) so every threshold
+# carries provenance — shipped defaults are sized so NORMAL is
+# byte-identical to the pre-admission repo at every existing test/soak
+# scale, and a loaded saturation-probe artifact rewrites the backlog
+# thresholds with ``source: probe``. This tuple only NAMES the override
+# keys the controller accepts; NTA018 bans bare threshold literals here.
+_CONFIG_KEYS = (
+    "brownout_backlog",
+    "shed_backlog",
+    "brownout_p99_ms",
+    "shed_p99_ms",
+    "exit_fraction",
+    "imbalance_ratio",
+    "imbalance_min_backlog",
+    "min_p99_samples",
+    "dwell_s",
+    "reeval_interval_s",
+    "retry_after_s",
+    "defer_delay_s",
+    "flap_window_s",
     # per-tier ready-depth ceilings as fractions of shed_backlog; low
     # defers first, high only past the shed point itself
-    "watermark_fractions": {TIER_HIGH: 1.0, TIER_NORMAL: 0.5, TIER_LOW: 0.25},
+    "watermark_fractions",
     # brownout batch amortization: widen the dequeue window instead of
     # thrashing small kernel passes
-    "brownout_batch_factor": 2,
-    "brownout_batch_timeout_s": 0.4,
+    "brownout_batch_factor",
+    "brownout_batch_timeout_s",
     # cost-aware shed ordering within the low tier: submissions at or
     # below this quantile of recently-seen cost demands defer instead of
     # shedding, so the expensive half of the tier sheds first
-    "shed_cost_quantile": 0.5,
-}
+    "shed_cost_quantile",
+)
+
+
+def _default_config() -> dict:
+    # lazy import: obs/__init__ transitively imports server modules, so
+    # a module-level import here would cycle (same workaround as
+    # obs/recorder.py's tier_of import)
+    from ..obs.calibrate import global_table
+
+    return global_table.admission_overrides()
 
 _LEVEL_GAUGE = "nomad.admission.level"
 
@@ -267,10 +280,10 @@ class AdmissionController:
         completions_fn: Optional[Callable[[], float]] = None,
         **overrides,
     ):
-        unknown = set(overrides) - set(_DEFAULTS)
+        unknown = set(overrides) - set(_CONFIG_KEYS)
         if unknown:
             raise TypeError(f"unknown admission overrides: {sorted(unknown)}")
-        cfg = dict(_DEFAULTS)
+        cfg = _default_config()
         cfg.update(overrides)
         for key, value in cfg.items():
             setattr(self, key, value)

@@ -60,6 +60,7 @@ class ServerConfig:
         eval_deadline: Optional[float] = None,
         eval_attempt_limit: Optional[int] = None,
         admission_overrides: Optional[dict] = None,
+        calibration_artifact: Optional[str] = None,
     ):
         import os
 
@@ -112,6 +113,12 @@ class ServerConfig:
         # (server/admission.py); None keeps the production defaults,
         # under which NORMAL behavior is identical to pre-admission.
         self.admission_overrides = admission_overrides
+        # path to a persisted saturation-probe artifact (obs/calibrate.py
+        # CALIB_r01.json): loaded into the server's calibration table at
+        # startup, deriving the admission backlog thresholds from the
+        # measured sustainable rate (source: probe). None = shipped
+        # defaults.
+        self.calibration_artifact = calibration_artifact
 
 
 class Server:
@@ -143,6 +150,22 @@ class Server:
         # enqueue gate can defer over-watermark external evals.
         from .admission import AdmissionController, HistWindow
 
+        # calibration plane (obs/calibrate.py): a per-server table serves
+        # /v1/agent/calibration and derives the admission defaults; a
+        # configured probe artifact rewrites the backlog thresholds with
+        # source: probe before the controller is built. The throughput
+        # estimator is the PROCESS-global one (the learned-mode kernels
+        # read it), refcount-attached to the flight recorder for the
+        # server's lifetime.
+        from ..obs.calibrate import CalibrationTable, global_estimator
+
+        self.calibration = CalibrationTable()
+        if self.config.calibration_artifact:
+            self.calibration.load_probe_artifact(self.config.calibration_artifact)
+        self.throughput_estimator = global_estimator
+        self.throughput_estimator.attach()
+        admission_cfg = self.calibration.admission_overrides()
+        admission_cfg.update(self.config.admission_overrides or {})
         self.admission = AdmissionController(
             clock=clock.monotonic if clock is not None else None,
             depth_fn=self.eval_broker.queue_depths,
@@ -150,7 +173,7 @@ class Server:
                 clock=clock.monotonic if clock is not None else None
             ),
             completions_fn=lambda: self.eval_broker.counters["acks"],
-            **(self.config.admission_overrides or {}),
+            **admission_cfg,
         )
         self.eval_broker.admission = self.admission
         self.plan_queue = PlanQueue()
@@ -408,6 +431,12 @@ class Server:
     def shutdown(self) -> None:
         if self._leader:
             self.revoke_leadership()
+        # release this server's hold on the process-global estimator
+        # (refcounted; the listener detaches with the last server)
+        est = getattr(self, "throughput_estimator", None)
+        if est is not None:
+            est.detach()
+            self.throughput_estimator = None
         # flush + release the durable log (InlineRaft.close is idempotent;
         # a consensus RaftNode is owned and closed by its ClusterServer)
         close = getattr(self.raft, "close", None)

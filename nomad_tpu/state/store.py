@@ -43,9 +43,10 @@ class SchedulerConfiguration:
     TPU algorithm registers under). Reference: structs.SchedulerConfiguration
     (nomad/structs/operator.go:128-220, default binpack :164-169)."""
 
-    # class-level default doubles as the fallback for configs restored
-    # from pre-explainability snapshots (pickle skips __init__)
+    # class-level defaults double as the fallback for configs restored
+    # from older snapshots (pickle skips __init__)
     placement_explanations = True
+    throughput_source = "declared"
 
     def __init__(
         self,
@@ -56,6 +57,7 @@ class SchedulerConfiguration:
         memory_oversubscription_enabled: bool = False,
         pause_eval_broker: bool = False,
         placement_explanations: bool = True,
+        throughput_source: str = "declared",
     ):
         self.scheduler_algorithm = scheduler_algorithm
         self.preemption_system_enabled = preemption_system_enabled
@@ -67,6 +69,10 @@ class SchedulerConfiguration:
         # bit-identical (the gate is Python-level) but no explanations
         # are built, recorded, or served
         self.placement_explanations = placement_explanations
+        # hetero throughput matrix source (obs/calibrate.py): "declared"
+        # = jobspec coefficients (byte-identical pre-calibration path),
+        # "learned" = the ThroughputEstimator's online telemetry values
+        self.throughput_source = throughput_source
 
 
 class _Tables:

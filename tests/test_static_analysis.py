@@ -1002,7 +1002,7 @@ class TestBaselineRatchet:
         assert sorted(r.id for r in (cls() for cls in REGISTRY)) == [
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
             "NTA007", "NTA008", "NTA009", "NTA010", "NTA011", "NTA012",
-            "NTA013", "NTA014", "NTA015", "NTA016", "NTA017",
+            "NTA013", "NTA014", "NTA015", "NTA016", "NTA017", "NTA018",
         ]
 
 
