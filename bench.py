@@ -786,6 +786,13 @@ def bench_soak(argv: list, batch_workers: int) -> dict:
         help="high-tier p99 eval-latency bound enforced in --overload "
         "mode (the SLO the admission plane defends)",
     )
+    p.add_argument(
+        "--incremental", choices=("on", "off", "ab"), default="off",
+        help="incremental score-state cache (device/cache.py): pin it "
+        "on or off for the soak, or 'ab' to run both arms back to back "
+        "and emit a per-arm comparison (steady-state p99, saturation "
+        "rate, rescore accounting)",
+    )
     args = p.parse_args(argv)
     mix = None
     if args.priority_mix:
@@ -795,7 +802,7 @@ def bench_soak(argv: list, batch_workers: int) -> dict:
         }
     if args.overload:
         return _bench_soak_overload(args, batch_workers, mix)
-    run = run_soak(
+    soak_kwargs = dict(
         seed=args.seed,
         seconds=args.seconds,
         rate=args.rate,
@@ -812,6 +819,9 @@ def bench_soak(argv: list, batch_workers: int) -> dict:
         priority_mix=mix,
         calibration_artifact=args.calib_from,
     )
+    if args.incremental == "ab":
+        return _bench_soak_incremental_ab(soak_kwargs)
+    run = _soak_incremental_arm(args.incremental == "on", soak_kwargs)
     d = run.to_dict()
     if run.saturation_rate is not None and args.calib_artifact:
         from nomad_tpu.obs.calibrate import write_probe_artifact
@@ -824,6 +834,82 @@ def bench_soak(argv: list, batch_workers: int) -> dict:
             probe_seconds=args.sat_probe_seconds,
         )
         d["calib_artifact"] = args.calib_artifact
+    return d
+
+
+def _soak_incremental_arm(on: bool, soak_kwargs: dict):
+    """Run one soak with the incremental score cache pinned on/off via
+    NOMAD_TPU_INCREMENTAL, restoring the ambient resolution after."""
+    from nomad_tpu.obs.loadgen import run_soak
+    from nomad_tpu.utils import backend
+
+    prev = os.environ.get("NOMAD_TPU_INCREMENTAL")
+    os.environ["NOMAD_TPU_INCREMENTAL"] = "on" if on else "off"
+    backend.reset_incremental()
+    try:
+        return run_soak(**soak_kwargs)
+    finally:
+        if prev is None:
+            os.environ.pop("NOMAD_TPU_INCREMENTAL", None)
+        else:
+            os.environ["NOMAD_TPU_INCREMENTAL"] = prev
+        backend.reset_incremental()
+
+
+def _bench_soak_incremental_ab(soak_kwargs: dict) -> dict:
+    """`bench.py soak --incremental ab` — back-to-back off/on arms over
+    the SAME seeded schedule (identical canonical blocks except the
+    ``incremental`` flag), compared on steady-state p99, saturation
+    rate, and the rescore accounting. Gates are honest measurements,
+    not assertions: both arms must hold the invariants; the latency
+    deltas are reported for the operator to judge at their scale."""
+    # discarded warmup: the first soak in a process pays every one-time
+    # jit trace/compile; without this the off arm (run first) would eat
+    # that cost and the A/B would flatter the on arm dishonestly
+    warm_kwargs = dict(
+        soak_kwargs,
+        seconds=min(4.0, float(soak_kwargs.get("seconds") or 4.0)),
+        saturation=False,
+    )
+    _soak_incremental_arm(False, warm_kwargs)
+    runs = {
+        arm: _soak_incremental_arm(arm == "on", soak_kwargs)
+        for arm in ("off", "on")
+    }
+
+    def _arm_stats(run) -> dict:
+        dc = run.slo.get("device_cache", {})
+        return {
+            "p99_ms": run.slo["eval_latency_ms"]["p99_ms"],
+            "p95_ms": run.slo["eval_latency_ms"]["p95_ms"],
+            "saturation_rate": run.saturation_rate,
+            "score_rows_rescored": dc.get("score_rows_rescored", 0),
+            "score_rows_reused": dc.get("score_rows_reused", 0),
+            "pipeline_overlap_ms": dc.get("pipeline_overlap_ms", 0.0),
+            "invariants_ok": run.ok,
+        }
+
+    off, on = _arm_stats(runs["off"]), _arm_stats(runs["on"])
+    sat_ratio = None
+    if off["saturation_rate"] and on["saturation_rate"]:
+        sat_ratio = round(on["saturation_rate"] / off["saturation_rate"], 3)
+    comparison = {
+        "off": off,
+        "on": on,
+        "p99_delta_ms": round(on["p99_ms"] - off["p99_ms"], 3),
+        "p99_improved": on["p99_ms"] <= off["p99_ms"],
+        "saturation_ratio": sat_ratio,
+        "saturation_not_worse": (
+            sat_ratio is None or sat_ratio >= 1.0
+        ),
+        "both_invariants_ok": off["invariants_ok"] and on["invariants_ok"],
+    }
+    # soak-shaped like the overload gate: the on arm is the headline
+    # run main() reports, the off arm rides along in full for the A/B
+    d = runs["on"].to_dict()
+    d["incremental_ab"] = comparison
+    d["arm_off"] = runs["off"].to_dict()
+    d["ok"] = bool(d["ok"]) and comparison["both_invariants_ok"]
     return d
 
 

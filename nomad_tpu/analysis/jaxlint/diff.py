@@ -23,6 +23,7 @@ from .fingerprint import fingerprint
 from . import retracer
 
 _MESH_ENV = "NOMAD_TPU_MESH"
+_INCR_ENV = "NOMAD_TPU_INCREMENTAL"
 
 
 def _fingerprints_here(entries) -> dict:
@@ -151,17 +152,81 @@ def prove_explain_invariance() -> dict:
     }
 
 
+def prove_incremental_invariance() -> dict:
+    """Run the placement exercise with the incremental score cache off,
+    then on (two passes: full rebuild + one dirty-row patch with a
+    generation swap between), and prove the incremental path added no
+    traced program: zero new XLA traces, zero new recorded specs, every
+    config's fingerprint unchanged. This is the jaxpr half of the
+    bit-identity pin — the cached device buffer feeds the kernel with
+    the same aval as a from-scratch ``shard_put``, so on and off trace
+    the identical kernel set.
+    """
+    from ...utils import backend
+    from .exercise import run_placement_paths
+
+    registry = retracer.import_fleet()
+    run_placement_paths(incremental=False)
+    entries = [
+        e for e in retracer.production_kernels(registry).values()
+        if e.specs
+    ]
+    specs_before = {e.short: set(e.specs) for e in entries}
+    traces_before = backend.trace_counts()
+    fps_before = _fingerprints_here(entries)
+
+    prev = os.environ.get(_INCR_ENV)
+    try:
+        os.environ[_INCR_ENV] = "on"
+        backend.reset_incremental()
+        run_placement_paths(incremental=True)
+    finally:
+        if prev is None:
+            os.environ.pop(_INCR_ENV, None)
+        else:
+            os.environ[_INCR_ENV] = prev
+        backend.reset_incremental()
+    traces_after = backend.trace_counts()
+    fps_after = _fingerprints_here(entries)
+
+    kernels: dict = {}
+    ok = True
+    for e in entries:
+        added_specs = sorted(set(e.specs) - specs_before[e.short])
+        added_traces = traces_after.get(e.name, 0) - traces_before.get(
+            e.name, 0
+        )
+        fp_equal = fps_before[e.short] == {
+            s: fps_after[e.short][s] for s in specs_before[e.short]
+        }
+        kernel_ok = not added_specs and added_traces == 0 and fp_equal
+        ok = ok and kernel_ok
+        kernels[e.short] = {
+            "added_specs": added_specs,
+            "added_traces": added_traces,
+            "fingerprints_equal": fp_equal,
+            "ok": kernel_ok,
+        }
+    return {
+        "claim": "incremental-on/off adds no traced program",
+        "ok": ok,
+        "kernels": kernels,
+    }
+
+
 def prove_all() -> dict:
-    """Both fleet invariants; ``ok`` is the conjunction. The full fleet
+    """All fleet invariants; ``ok`` is the conjunction. The full fleet
     exercise runs between the provers so the mesh differ covers every
     production kernel (hetero, cp, preemption, score-matrix), not just
-    the placement paths the explain prover drives."""
+    the placement paths the explain and incremental provers drive."""
     from .exercise import exercise_fleet
 
     explain = prove_explain_invariance()
+    incremental = prove_incremental_invariance()
     mesh = prove_mesh_invariance(exercise_fleet())
     return {
-        "ok": explain["ok"] and mesh["ok"],
+        "ok": explain["ok"] and incremental["ok"] and mesh["ok"],
         "explain": explain,
+        "incremental": incremental,
         "mesh": mesh,
     }

@@ -93,9 +93,17 @@ def _blocks(ct, kind, values=4):
     )
 
 
-def run_placement_paths(explain: bool = False) -> int:
+def run_placement_paths(
+    explain: bool = False, incremental: bool = False
+) -> int:
     """Route one tiny batch through each PlacementKernel family.
-    Returns the number of placement results produced."""
+    Returns the number of placement results produced.
+
+    With ``incremental`` a DeviceStateCache rides along as the cluster's
+    score cache and the batch runs TWICE — full rebuild, then one
+    churned row through the dirty-patch path with a generation swap
+    between — so the differ observes every incremental code path while
+    proving none of them traced a new program."""
     from ...device.score import (
         BLOCK_EVEN_SPREAD,
         BLOCK_TARGET_SPREAD,
@@ -103,6 +111,12 @@ def run_placement_paths(explain: bool = False) -> int:
     )
 
     ct = _cluster()
+    cache = None
+    if incremental:
+        from ...device.cache import DeviceStateCache
+
+        cache = DeviceStateCache()
+        ct.score_cache = cache
     asks = [
         _ask(ct, "fast-a", 3),  # closed-form top-k
         _ask(ct, "fast-b", 2),
@@ -112,6 +126,11 @@ def run_placement_paths(explain: bool = False) -> int:
     ]
     kernel = PlacementKernel("binpack")
     results = kernel.place(ct, asks, explain=explain)
+    if cache is not None:
+        cache.score_commit()
+        ct.used[0, 0] += 128.0  # one dirty row → per-shard patch pass
+        results = kernel.place(ct, asks, explain=explain)
+        cache.score_commit()
     return sum(1 for r in results if r is not None)
 
 

@@ -20,6 +20,7 @@ from .shardingseam import ShardingSeamDiscipline  # noqa: E402
 from .solverseam import SolverSeamDiscipline  # noqa: E402
 from .kernelseam import KernelSeamDiscipline  # noqa: E402
 from .provenance import ConstantProvenanceDiscipline  # noqa: E402
+from .scorestate import ScoreStateDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -40,6 +41,7 @@ REGISTRY = [
     SolverSeamDiscipline,  # NTA016
     KernelSeamDiscipline,  # NTA017
     ConstantProvenanceDiscipline,  # NTA018
+    ScoreStateDiscipline,  # NTA019
 ]
 
 __all__ = ["REGISTRY"]

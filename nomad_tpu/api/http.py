@@ -1653,6 +1653,10 @@ class HTTPAgent:
             "errors": flight_recorder.errors(),
             "kernels": kernel_profile(),
             "kernel_fingerprints": fingerprints,
+            # incremental-rescoring accounting (device/cache.py):
+            # rows patched vs served resident, generation swaps, and
+            # the pipeline-overlap wall time the commit thread hid
+            "device_cache": self.server.device_cache.device_counters(),
         }
 
     def handle_agent_resilience(self, method, body, query):

@@ -505,6 +505,19 @@ def check_cluster(
             for detail in mismatches:
                 report._fail("shard_consistency", "device_cache", detail)
             report.info["device_cache"] = cache.device_counters()
+    # Score half of law 12: the persisted score-state shards (incremental
+    # rescoring, device/cache.py) re-gathered to host must equal their
+    # generation mirror bitwise — including after cache.score_refresh_drop
+    # recovery and killed commits. Checked whenever a score view ever
+    # materialized; unlike the capacity half it also exists with the mesh
+    # off (the degenerate path persists a whole-tensor buffer).
+    if cache is not None:
+        score_mismatches = cache.verify_score_view()
+        if score_mismatches is not None:
+            report.checked["shard_consistency"] = True
+            for detail in score_mismatches:
+                report._fail("shard_consistency", "score_view", detail)
+            report.info["device_cache"] = cache.device_counters()
 
     # -- calibration_sanity ------------------------------------------------
     # Law 14: estimation degrades to declared, never to garbage. Checked

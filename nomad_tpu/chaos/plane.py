@@ -88,6 +88,11 @@ SITES: dict[str, tuple[str, ...]] = {
     # exactly one of placed/deferred/failed and capacity is never
     # exceeded post-round
     "cp.round_perturb": ("perturb",),
+    # incremental score state (device/cache.py): drop one per-shard
+    # score patch — recovery must be a full score rebuild on the same
+    # access, never a stale device row; the staged/committed mirrors
+    # stay bitwise-exact either way (invariant law 12, score half)
+    "cache.score_refresh_drop": ("drop",),
     # calibration plane (obs/calibrate.py): drop estimator input samples
     # before they reach their cell — starved cells must keep reporting
     # source: default and answer the declared anchor, never a garbage
@@ -125,6 +130,8 @@ _HORIZON = {
     "mesh.shard_refresh_drop": (0.125, 2),
     # hit once per joint CP placement pass, not per workload op
     "cp.round_perturb": (0.125, 2),
+    # hit per score-view access with dirty rows pending (incremental on)
+    "cache.score_refresh_drop": (0.125, 2),
     # hit once per estimator input sample (span fan-out rate)
     "calib.telemetry_drop": (1.0, 8),
 }

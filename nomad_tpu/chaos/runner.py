@@ -229,10 +229,25 @@ def run_chaos(
     schedule: Optional[list[FaultSpec]] = None,
     quiesce_timeout: float = 60.0,
     num_batch_workers: int = 1,
+    incremental: Optional[bool] = None,
 ) -> ChaosRun:
-    """One full chaos cycle: boot, inject, quiesce, check, tear down."""
+    """One full chaos cycle: boot, inject, quiesce, check, tear down.
+
+    ``incremental`` pins the score-state cache (device/cache.py) on or
+    off for the run; None inherits the ambient NOMAD_TPU_INCREMENTAL
+    resolution. Chaos runs with it on exercise cache.score_refresh_drop
+    and the score half of invariant law 12."""
+    import os
+
     from ..obs.recorder import flight_recorder
     from ..server.server import Server, ServerConfig
+    from ..utils import backend as _backend
+
+    _incr_prev: Optional[str] = None
+    if incremental is not None:
+        _incr_prev = os.environ.get("NOMAD_TPU_INCREMENTAL")
+        os.environ["NOMAD_TPU_INCREMENTAL"] = "on" if incremental else "off"
+        _backend.reset_incremental()
 
     faults = tuple(faults)
     plane = FaultPlane(
@@ -306,6 +321,12 @@ def run_chaos(
             count_swallowed("chaos", None)
         _breaker.configure(**_prev_breaker)
         _breaker.reset_all()
+        if incremental is not None:
+            if _incr_prev is None:
+                os.environ.pop("NOMAD_TPU_INCREMENTAL", None)
+            else:
+                os.environ["NOMAD_TPU_INCREMENTAL"] = _incr_prev
+            _backend.reset_incremental()
     return ChaosRun(
         seed=seed,
         steps=steps,

@@ -35,6 +35,30 @@ def _node_used(snap, node_id: str, dims: int) -> np.ndarray:
     return vec
 
 
+class ScoreState:
+    """One generation of the persisted device-resident score view.
+
+    The score planes every placement kernel computes are pure functions
+    of ``(capacity, used, ask)``; capacity is already device-resident
+    (``_device_capacity_locked``) and the asks are per-pass, so the
+    persisted half of the score state is ``used`` — the alloc-churn-hot
+    tensor that the from-scratch path re-uploads whole every pass. A
+    generation is immutable once built (jax buffers are, and the host
+    mirror is a private copy): the double-buffered pipeline hands the
+    previous generation to an in-flight pass while the next one is
+    staged, and ``score_commit`` swaps staged → committed at the merge
+    point. ``used_host`` is the exact bytes on device — the dirty-row
+    diff and ``verify_score_view`` both compare against it bitwise."""
+
+    __slots__ = ("used_dev", "used_host", "layout_gen", "gen")
+
+    def __init__(self, used_dev, used_host, layout_gen: int, gen: int):
+        self.used_dev = used_dev
+        self.used_host = used_host
+        self.layout_gen = layout_gen
+        self.gen = gen
+
+
 class DeviceStateCache:
     """One per server/harness; thread-safe. ``tensors(snap)`` returns a
     ClusterTensors at exactly ``snap.index`` whose ``used`` array is a
@@ -59,10 +83,27 @@ class DeviceStateCache:
         self._dirty_regions: set[int] = set()
         self.shard_uploads = 0  # per-shard (partial) device refreshes
         self.full_uploads = 0  # whole-tensor device uploads
+        # score-state persistence (NOMAD_TPU_INCREMENTAL): double-
+        # buffered device-resident ``used`` generations. ``_score`` is
+        # the committed generation; ``score_view`` stages the next one
+        # (dirty rows diffed bitwise against the newest mirror, clean
+        # shards keep their buffers) and ``score_commit`` swaps it in
+        # from the worker's commit path. Dirty detection is an exact
+        # host compare rather than journal bookkeeping: overlay
+        # overrides and partially-landed commits self-heal on the next
+        # pass because ANY divergence from the mirror re-uploads.
+        self._score: ScoreState | None = None  # committed generation
+        self._score_staged: ScoreState | None = None
+        self.score_rows_rescored = 0  # rows re-uploaded (score inputs changed)
+        self.score_rows_reused = 0  # rows served from the resident buffer
+        self.score_patch_uploads = 0  # partial (dirty-slice) refreshes
+        self.score_full_rebuilds = 0  # whole-tensor score-state uploads
+        self.score_swaps = 0  # staged → committed generation swaps
+        self.pipeline_overlap_ms = 0.0  # commit time hidden behind passes
 
     # -- public -----------------------------------------------------------
     def tensors(self, snap) -> ClusterTensors:
-        from ..utils.backend import get_mesh
+        from ..utils.backend import get_mesh, incremental_enabled
 
         with self._lock:
             ct = self._refresh_locked(snap)
@@ -70,6 +111,14 @@ class DeviceStateCache:
             cfg = get_mesh()
             if cfg.active:
                 out.device_capacity = self._device_capacity_locked(ct, cfg)
+            if incremental_enabled():
+                # the incremental seam the kernels read (device/score.py
+                # used_device): present ⇒ the pass's ``used`` upload may
+                # be served from the persisted score state. Off-mode
+                # tensors carry None and take the from-scratch path
+                # untouched — the Python-level gate the jaxpr-identity
+                # pin depends on.
+                out.score_cache = self
             return out
 
     def invalidate(self) -> None:
@@ -77,14 +126,30 @@ class DeviceStateCache:
             self._ct = None
             self._dev_capacity = None
             self._dirty_regions.clear()
+            self._score = None
+            self._score_staged = None
 
     def device_counters(self) -> dict:
         with self._lock:
+            state = self._score_staged or self._score
             return {
                 "shard_uploads": self.shard_uploads,
                 "full_uploads": self.full_uploads,
                 "dirty_regions": len(self._dirty_regions),
+                "score_rows_rescored": self.score_rows_rescored,
+                "score_rows_reused": self.score_rows_reused,
+                "score_patch_uploads": self.score_patch_uploads,
+                "score_full_rebuilds": self.score_full_rebuilds,
+                "score_swaps": self.score_swaps,
+                "score_gen": 0 if state is None else state.gen,
+                "pipeline_overlap_ms": round(self.pipeline_overlap_ms, 3),
             }
+
+    def note_overlap(self, ms: float) -> None:
+        """Worker-reported pipeline overlap: wall-clock the commit
+        thread ran underneath the NEXT pass's prepare + device work."""
+        with self._lock:
+            self.pipeline_overlap_ms += max(0.0, float(ms))
 
     def verify_device_view(self) -> list[str] | None:
         """Invariant law 12 (shard_consistency) probe: re-gather every
@@ -120,6 +185,183 @@ class DeviceStateCache:
                     problems.append(
                         f"rows[{start}:{start + host.shape[0]}] on "
                         f"{sh.device} diverge from store-derived capacity"
+                    )
+            return problems
+
+    # -- score-state persistence (incremental rescoring) -------------------
+    def score_view(self, ct, used0: np.ndarray, cfg=None):
+        """Device-resident ``used`` for one scoring pass, bitwise equal
+        to ``used0`` — or None when the incremental path is inactive
+        (callers ``shard_put`` from scratch, exactly the off-mode path).
+
+        Stages the next score-state generation: rows whose bytes differ
+        from the newest mirror re-upload (per dirty shard under a mesh,
+        whole-tensor when degenerate or chaos-dropped); clean shards
+        keep their existing device buffers and their per-shard top-k
+        heads are recomputed from resident data — the hierarchical
+        merge in device/score.py (``_topk_nodes``) runs unchanged, so
+        the traced program is identical to from-scratch and only the
+        host→device traffic scales with the dirt. The staged generation
+        becomes committed at ``score_commit`` (worker commit path)."""
+        from ..utils.backend import get_mesh, incremental_enabled
+
+        if not incremental_enabled():
+            return None
+        if cfg is None:
+            cfg = get_mesh()
+        used0 = np.asarray(used0, dtype=np.float32)
+        layout_gen = getattr(ct, "layout_gen", 0)
+        with self._lock:
+            base = self._score_staged or self._score
+            n_rows = int(used0.shape[0])
+            if (
+                base is None
+                or base.layout_gen != layout_gen
+                or base.used_host.shape != used0.shape
+            ):
+                # first access, layout change (full reflatten re-sorts
+                # rows: every cached partial is row-misaligned), or a
+                # shape flip — rebuild the whole score state
+                return self._score_rebuild_locked(used0, layout_gen, cfg)
+            dirty = np.flatnonzero(
+                np.any(base.used_host != used0, axis=1)
+            )
+            if dirty.size == 0:
+                self.score_rows_reused += n_rows
+                self._score_staged = ScoreState(
+                    base.used_dev, base.used_host, layout_gen, base.gen
+                )
+                return base.used_dev
+            from ..chaos.plane import chaos_site
+
+            if chaos_site("cache.score_refresh_drop") == "drop":
+                # a dropped dirty-slice refresh must never serve stale
+                # score inputs: recovery is a whole-tensor re-upload on
+                # this access (mesh.shard_refresh_drop discipline)
+                return self._score_rebuild_locked(used0, layout_gen, cfg)
+            self.score_rows_rescored += int(dirty.size)
+            self.score_rows_reused += n_rows - int(dirty.size)
+            dev = self._score_patch_locked(base, used0, dirty, cfg)
+            self._score_staged = ScoreState(
+                dev, used0.copy(), layout_gen, base.gen + 1
+            )
+            self.score_patch_uploads += 1
+            return dev
+
+    def _score_rebuild_locked(self, used0, layout_gen: int, cfg):
+        from ..utils.backend import shard_put
+
+        # upload from a PRIVATE copy: on the CPU backend device_put may
+        # alias the host numpy buffer zero-copy, and a buffer aliasing
+        # the caller's live ``used`` array would mutate under alloc
+        # churn — the generation must hold the exact bytes it was built
+        # from. The copy doubles as the mirror.
+        host = used0.copy()
+        dev = shard_put(host, ("nodes",), cfg)
+        base = self._score_staged or self._score
+        gen = 1 if base is None else base.gen + 1
+        self._score_staged = ScoreState(
+            dev, host, layout_gen, gen
+        )
+        self.score_full_rebuilds += 1
+        self.score_rows_rescored += int(used0.shape[0])
+        return dev
+
+    def _score_patch_locked(self, base: ScoreState, used0, dirty, cfg):
+        """New device buffer for ``used0``: under a mesh whose node axis
+        divides the rows, re-upload only the shards containing dirty
+        rows and reassemble around the clean shards' existing buffers
+        (the capacity protocol); degenerate single-device falls back to
+        a whole-tensor upload — there is no partial-placement primitive
+        for an unsharded buffer, and the reuse win there is the
+        zero-dirty case above."""
+        from ..utils.backend import shard_put
+
+        mp = cfg.n_node_shards
+        n_rows = int(used0.shape[0])
+        arr = base.used_dev
+        if (
+            mp <= 1
+            or n_rows % mp != 0
+            or getattr(arr, "sharding", None) is None
+        ):
+            # .copy() for the same aliasing reason as the shard path
+            return shard_put(used0.copy(), ("nodes",), cfg)
+        import jax
+
+        seg = n_rows // mp
+        dirty_shards = {int(r) // seg for r in dirty}
+        bufs = []
+        for sh in arr.addressable_shards:
+            start = sh.index[0].start or 0
+            if start // seg in dirty_shards:
+                # .copy(): CPU device_put may alias host memory (see
+                # _score_rebuild_locked) — a dirty-slice buffer must
+                # not track the caller's live ``used`` rows
+                bufs.append(
+                    jax.device_put(
+                        used0[start : start + seg].copy(), sh.device
+                    )
+                )
+            else:
+                bufs.append(sh.data)
+        return jax.make_array_from_single_device_arrays(
+            used0.shape, arr.sharding, bufs
+        )
+
+    def score_commit(self) -> None:
+        """Swap the staged score-state generation in as committed — the
+        double buffer's merge point, called from the worker's commit
+        path. The ONE ``jax.block_until_ready`` fence of the pipeline
+        lives here: patch uploads dispatch async and overlap the
+        previous pass's verify/commit; by swap time they must be real
+        buffers, never in-flight transfers a holder could stall on."""
+        from ..utils.backend import transfer_fence
+
+        with self._lock:
+            staged = self._score_staged
+            if staged is None:
+                return
+            self._score_staged = None
+            if self._score is not None and staged.gen == self._score.gen:
+                return  # zero-dirty pass: same generation, no swap
+            self._score = staged
+            self.score_swaps += 1
+        transfer_fence(staged.used_dev)
+
+    def score_abort(self) -> None:
+        """Drop the staged generation (a pass that died before commit);
+        the next pass diffs against the committed mirror and re-uploads
+        whatever the aborted pass had staged — correctness never
+        depends on an abort being observed."""
+        with self._lock:
+            self._score_staged = None
+
+    def verify_score_view(self) -> list[str] | None:
+        """Invariant law 12 (shard_consistency), score half: re-gather
+        every device-resident ``used`` shard of the newest score-state
+        generation and compare *bitwise* against its host mirror — the
+        ``verify_device_view`` analog for the incremental path. Returns
+        None when no score state is materialized (incremental off, or
+        never accessed); else a list of mismatch details (empty ==
+        consistent)."""
+        with self._lock:
+            state = self._score_staged or self._score
+            if state is None:
+                return None
+            problems: list[str] = []
+            ref = state.used_host
+            for sh in state.used_dev.addressable_shards:
+                host = np.asarray(sh.data)
+                want = ref[sh.index]
+                if host.shape != want.shape or (
+                    host.tobytes() != want.tobytes()
+                ):
+                    start = sh.index[0].start or 0
+                    problems.append(
+                        f"score rows[{start}:{start + host.shape[0]}] on "
+                        f"{sh.device} diverge bitwise from the gen-"
+                        f"{state.gen} mirror"
                     )
             return problems
 

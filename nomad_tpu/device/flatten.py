@@ -118,6 +118,15 @@ class ClusterTensors:
     # Shared by reference across the per-call used-copy wrappers — the
     # buffer is immutable on device and regenerated per cache refresh.
     device_capacity: object = None
+    # incremental-rescoring seam (NOMAD_TPU_INCREMENTAL): the owning
+    # DeviceStateCache, attached by ``tensors()`` only when the
+    # incremental path is on. Kernels route their per-pass ``used``
+    # upload through ``cache.score_view`` when present (device/score.py
+    # used_device); None ⇒ the from-scratch ``shard_put`` path, byte
+    # for byte the pre-incremental upload. Mutating the cached score
+    # tensors anywhere but the DeviceStateCache refresh API is banned
+    # (lint rule NTA019).
+    score_cache: object = None
     # row-layout generation: bumped ONLY by a full reflatten (which may
     # re-sort rows); preserved across incremental refreshes and the
     # per-call used-copy. Consumers holding row-indexed overlays (the

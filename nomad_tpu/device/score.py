@@ -1086,6 +1086,26 @@ _BATCH_SPECS = {
 }
 
 
+def used_device(cluster, used0, cfg=None):
+    """The one seam every kernel's per-pass ``used`` upload routes
+    through. With incremental rescoring on (the tensors carry a
+    ``score_cache``), the DeviceStateCache serves a device-resident
+    buffer bitwise equal to ``used0`` — only dirty slices travelled;
+    otherwise (or when the cache declines) the from-scratch
+    ``shard_put``, byte for byte the pre-incremental upload. The
+    returned array has the same aval either way, so the traced program
+    is one and the same — the jaxpr-identity pin of the incremental
+    path (analysis/jaxlint/diff.py)."""
+    if cfg is None:
+        cfg = get_mesh()
+    cache = getattr(cluster, "score_cache", None)
+    if cache is not None:
+        dev = cache.score_view(cluster, used0, cfg)
+        if dev is not None:
+            return dev
+    return shard_put(used0, ("nodes",), cfg)
+
+
 def _device_batch(batch: dict, cfg=None) -> dict:
     """Upload a host batch dict through the sharding seam: NamedSharding
     placement when a mesh is active, plain jnp.asarray otherwise (the
@@ -1355,7 +1375,7 @@ class PlacementKernel:
         fused = np.array(
             place_closed_form_kernel(
                 self._capacity_dev(cluster, cfg),
-                shard_put(used0, ("nodes",), cfg),
+                used_device(cluster, used0, cfg),
                 **_device_batch(batch, cfg),
                 algorithm_spread=jnp.asarray(self.algorithm_spread),
                 max_j=max_j,
@@ -1406,7 +1426,7 @@ class PlacementKernel:
         cfg = self.mesh_cfg()
         choices, scores = place_value_scan_kernel(
             self._capacity_dev(cluster, cfg),
-            shard_put(used0, ("nodes",), cfg),
+            used_device(cluster, used0, cfg),
             **_device_batch(batch, cfg),
             algorithm_spread=jnp.asarray(self.algorithm_spread),
             max_j=max_j,
@@ -1446,7 +1466,7 @@ class PlacementKernel:
         cfg = self.mesh_cfg()
         choices, scores = place_spread_chunked_kernel(
             self._capacity_dev(cluster, cfg),
-            shard_put(used0, ("nodes",), cfg),
+            used_device(cluster, used0, cfg),
             **_device_batch(batch, cfg),
             algorithm_spread=jnp.asarray(self.algorithm_spread),
             max_j=max_j,
@@ -1527,7 +1547,7 @@ class PlacementKernel:
         cfg = self.mesh_cfg()
         choices, scores = place_spread_opv_kernel(
             self._capacity_dev(cluster, cfg),
-            shard_put(used0, ("nodes",), cfg),
+            used_device(cluster, used0, cfg),
             **_device_batch(batch, cfg),
             enforce_idx=jnp.asarray(enforce_idx),
             algorithm_spread=jnp.asarray(self.algorithm_spread),

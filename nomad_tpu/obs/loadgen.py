@@ -197,6 +197,7 @@ class SoakRun:
         duration_s: float,
         saturation_rate: Optional[float] = None,
         admission: Optional[dict] = None,
+        incremental: bool = False,
     ):
         self.seed = seed
         self.seconds = seconds
@@ -213,6 +214,10 @@ class SoakRun:
         # measured controller snapshot + recovered/conserved flags
         # (diagnostics — never part of canonical())
         self.admission = admission
+        # whether the incremental score cache was on for the run — a
+        # config axis, so it belongs in canonical(): on/off arms of an
+        # A/B differ byte-for-byte exactly here
+        self.incremental = incremental
 
     @property
     def ok(self) -> bool:
@@ -231,6 +236,7 @@ class SoakRun:
             "rate": self.rate,
             "nodes": self.nodes,
             "batch_workers": self.batch_workers,
+            "incremental": self.incremental,
             "schedule": list(self.schedule_rows),
             "targets": self.targets.to_dict(),
             "slo_schema": list(SLO_SCHEMA),
@@ -410,6 +416,7 @@ def run_soak(
     """One full soak cycle: boot, seed fleet, replay the schedule on
     the wall clock, quiesce, check invariants, build the SLO report."""
     from ..server.server import Server, ServerConfig
+    from ..utils.backend import incremental_enabled
 
     targets = targets or SloTargets()
     schedule = build_schedule(
@@ -543,6 +550,7 @@ def run_soak(
         duration_s=time.perf_counter() - t_start,
         saturation_rate=sat,
         admission=admission,
+        incremental=incremental_enabled(),
     )
 
 

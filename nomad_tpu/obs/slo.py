@@ -84,6 +84,11 @@ SLO_SCHEMA = tuple(sorted(
         "calibration.estimator_samples",
     ]
     + [
+        "device_cache.score_rows_rescored",
+        "device_cache.score_rows_reused",
+        "device_cache.pipeline_overlap_ms",
+    ]
+    + [
         "ring_coverage.traces_recorded",
         "ring_coverage.traces_evicted",
         "ring_coverage.coverage",
@@ -328,6 +333,20 @@ class SloCollector:
             estimator=getattr(self._server, "throughput_estimator", None),
         )
 
+    def _device_cache_block(self) -> dict:
+        """Incremental-rescoring summary for the report: rows served
+        from the resident score state vs re-uploaded, and how much
+        commit wall time the pipelined loop hid under the next pass.
+        Zeros from a server-less collector (the shape — three scalars
+        — is pinned either way)."""
+        cache = getattr(self._server, "device_cache", None)
+        counters = cache.device_counters() if cache is not None else {}
+        return {
+            "score_rows_rescored": counters.get("score_rows_rescored", 0),
+            "score_rows_reused": counters.get("score_rows_reused", 0),
+            "pipeline_overlap_ms": counters.get("pipeline_overlap_ms", 0.0),
+        }
+
     # -- report ------------------------------------------------------------
     def measured(self) -> dict:
         """The ``slo`` block: everything measured since the collector
@@ -385,6 +404,7 @@ class SloCollector:
             },
             "counters": ctr,
             "calibration": self._calibration_block(),
+            "device_cache": self._device_cache_block(),
             "ring_coverage": {
                 "traces_recorded": recorded,
                 "traces_evicted": evicted,

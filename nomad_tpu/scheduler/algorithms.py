@@ -176,7 +176,7 @@ def score_group(
     below is untouched either way) the return grows a third element, an
     ``obs.explain.PlacementExplanation`` carrying top-k candidates and
     the feasibility-rejection histogram."""
-    from ..device.score import score_matrix_kernel
+    from ..device.score import score_matrix_kernel, used_device
     from ..utils.backend import get_mesh, shard_put
 
     cfg = get_mesh()
@@ -188,7 +188,7 @@ def score_group(
             throughputs = (tp / np.float32(best))[None, :]
     finals, fits = score_matrix_kernel(
         shard_put(np.asarray(ct.capacity), ("nodes",), cfg),
-        shard_put(np.asarray(ct.used), ("nodes",), cfg),
+        used_device(ct, np.asarray(ct.used), cfg),
         shard_put(ga.ask[None, :], ("groups",), cfg),
         shard_put(ga.eligible[None, :], ("groups", "nodes"), cfg),
         shard_put(ga.job_counts[None, :], ("groups", "nodes"), cfg),

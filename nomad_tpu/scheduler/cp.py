@@ -218,12 +218,13 @@ class CpPlacementKernel:
             used_override=kwargs.get("used_override"),
             lam0=lam0,
         )
+        from ..device.score import used_device
         from ..utils.backend import shard_put
 
         cfg = self.mesh_cfg()
         choices, choice_scores, used, rounds, _lam = cp_place_kernel(
             shard_put(batch.capacity, ("nodes",), cfg),
-            shard_put(batch.used, ("nodes",), cfg),
+            used_device(cluster, batch.used, cfg),
             shard_put(batch.asks, ("groups",), cfg),
             shard_put(batch.counts, ("groups",), cfg),
             shard_put(batch.eligible, ("groups", "nodes"), cfg),

@@ -389,12 +389,13 @@ class HeteroPlacementKernel:
             )
             elig_tp = np.where(batch.eligible, batch.tp, np.float32(0.0))
             batch.tpmax = elig_tp.max(axis=1).astype(np.float32)
+        from ..device.score import used_device
         from ..utils.backend import shard_put
 
         cfg = self.mesh_cfg()
         choices, choice_tp, _ = hetero_place_kernel(
             shard_put(batch.capacity, ("nodes",), cfg),
-            shard_put(batch.used, ("nodes",), cfg),
+            used_device(cluster, batch.used, cfg),
             shard_put(batch.asks, ("groups",), cfg),
             shard_put(batch.counts, ("groups",), cfg),
             shard_put(batch.eligible, ("groups", "nodes"), cfg),

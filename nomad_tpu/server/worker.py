@@ -187,6 +187,10 @@ class Worker:
         # SERVER-SHARED overlay (server/overlay.py) so concurrent
         # batching workers see each other's in-flight placements too.
         self._commit_thread: Optional[threading.Thread] = None
+        # perf_counter stamp the commit thread writes in its finally;
+        # the next pass's join site reads it to account how much of the
+        # commit's wall time genuinely overlapped device work
+        self._commit_done_at: float = 0.0
         # eval-lifecycle deadlines (resilience layer): the injectable
         # cluster clock when configured, else wall time
         cfg = getattr(server, "config", None)
@@ -439,6 +443,11 @@ class Worker:
         # to include everything the dropped overlay was predicting
         # (resetting from the commit thread let the next pass freeze a
         # pre-commit base and cascade into applier rejections).
+        commit_alive_at_start = (
+            self._commit_thread is not None
+            and self._commit_thread.is_alive()
+        )
+        t_pass0 = time.perf_counter()
         if self._commit_thread is not None and (
             not self._commit_thread.is_alive()
         ):
@@ -649,6 +658,17 @@ class Worker:
         # commit starts (plan order per job; one in-flight commit bounds
         # memory), but the NEXT device pass overlaps THIS commit.
         self._join_commit()
+        if commit_alive_at_start:
+            # the previous commit ran concurrently with this pass's
+            # prepare/flatten/device phases from t_pass0 until it
+            # finished (or until the join, whichever came first) —
+            # that interval is wall time the pipeline genuinely hid
+            t_join_end = time.perf_counter()
+            overlap_s = max(
+                0.0, min(self._commit_done_at, t_join_end) - t_pass0
+            )
+            metrics.measure("nomad.worker.pipeline_overlap", overlap_s)
+            self.server.device_cache.note_overlap(overlap_s * 1000.0)
         if not all_asks:
             # the marker is taken in the device-pass block; a batch with
             # no kernel work (all singles) still needs it for the commit
@@ -680,6 +700,15 @@ class Worker:
             metrics.incr("nomad.chaos.thread_kills")
             count_swallowed("chaos", e)
         finally:
+            # Promote the pass's staged score generation (device/cache.py):
+            # the swap carries the ONE transfer fence of the pipeline, so
+            # it lands here at the merge point — after the commit's store
+            # writes, before the overlay releases. Runs on the kill path
+            # too: the staged buffer is still an exact mirror of the used
+            # matrix it was built from, and any store rows the killed
+            # commit never landed show up as dirty bytes next pass.
+            self.server.device_cache.score_commit()
+            self._commit_done_at = time.perf_counter()
             # must release the SAME overlay whose commit_started marker
             # the device pass took (the worker's own in lane mode)
             self._my_overlay().commit_finished()

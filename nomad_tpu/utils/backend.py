@@ -633,6 +633,58 @@ def shard_put(x, axes, cfg: "MeshConfig | None" = None):
     return jax.device_put(x, NamedSharding(cfg.mesh, PartitionSpec(*use)))
 
 
+# -- incremental score-state seam ---------------------------------------------
+#
+# ``NOMAD_TPU_INCREMENTAL`` gates the DeviceStateCache's score-state
+# persistence (device/cache.py): with it on, the per-pass ``used``
+# tensor stays device-resident across passes and only dirty slices
+# re-upload. Resolved once like the mesh spec; the gate is PYTHON-level
+# (the resident buffer has the same aval as a fresh ``shard_put``), so
+# flipping it can never change a traced program — the jaxlint differ
+# (analysis/jaxlint/diff.py: prove_incremental_invariance) pins that.
+
+_INCR_ENV = "NOMAD_TPU_INCREMENTAL"
+
+_incr_lock = threading.Lock()
+_incr_enabled = None  # cached bool | None (None = not resolved yet)
+
+
+def incremental_enabled() -> bool:
+    """The process-wide incremental-rescoring decision, resolved once
+    from ``NOMAD_TPU_INCREMENTAL`` (``on``/``1``/``true`` enable; unset
+    or anything else is off — the from-scratch reference path). Call
+    ``reset_incremental()`` after changing the env in tests."""
+    global _incr_enabled
+    val = _incr_enabled
+    if val is not None:
+        return val
+    with _incr_lock:
+        if _incr_enabled is None:
+            spec = os.environ.get(_INCR_ENV, "")
+            _incr_enabled = spec.strip().lower() in ("on", "1", "true")
+        return _incr_enabled
+
+
+def reset_incremental() -> None:
+    global _incr_enabled
+    with _incr_lock:
+        _incr_enabled = None
+
+
+def transfer_fence(*arrays) -> None:
+    """The ONE sanctioned ``jax.block_until_ready`` fence of the
+    pipelined device loop. ``shard_put``/per-shard patch uploads
+    dispatch asynchronously; the double-buffered score-state generations
+    swap on commit, and THIS is where the swap synchronizes — never
+    inside the upload path, or the overlap the pipeline exists to win
+    is serialized away."""
+    import jax
+
+    for a in arrays:
+        if a is not None:
+            jax.block_until_ready(a)
+
+
 def cpu_fallback_env(n_devices: int | None = None) -> dict:
     """A copy of os.environ steered to the CPU backend: JAX_PLATFORMS=cpu,
     the axon sitecustomize stripped from PYTHONPATH, and (optionally) a

@@ -217,6 +217,17 @@ class TestDurableSingleServer:
                 lambda: len(srv.store.allocs_by_job("default", job.id)) == 3,
                 msg="initial placement",
             )
+            # the eval-status commit trails the plan commit; wait for it
+            # so latest_index is stable before we snapshot it (otherwise
+            # it can land between the read and shutdown, and WAL replay
+            # recovers one index more than we recorded)
+            wait_until(
+                lambda: all(
+                    e.status in ("complete", "failed", "canceled")
+                    for e in srv.store.evals_by_job("default", job.id)
+                ),
+                msg="eval completion committed",
+            )
             pre_allocs = {
                 a.id for a in srv.store.allocs_by_job("default", job.id)
             }

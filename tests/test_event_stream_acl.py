@@ -152,6 +152,14 @@ class TestEventStreamACL:
         t.start()
         time.sleep(0.5)
         req(agent, f"/v1/acl/token/{acc}", method="DELETE", token=mgmt)
+        # poll until the revocation is visible (a fixed sleep races the
+        # delete's apply under load and the publish slips through)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            _, body = req(agent, "/v1/acl/tokens", token=mgmt)
+            if acc not in {t_["AccessorID"] for t_ in json.loads(body)}:
+                break
+            time.sleep(0.05)
         time.sleep(0.5)
         publish_mixed(server)  # would match the token's namespace
         t.join(timeout=10)

@@ -1,0 +1,444 @@
+"""Incremental rescoring + pipelined device loop (device/cache.py).
+
+Pins the tentpole contracts from the ISSUE: the incremental path is
+*bit-identical* (uint32 score views) to from-scratch across meshes,
+seeds, and all four kernel families; the staged/committed generation
+protocol orders swaps correctly — including under a chaos-killed commit
+thread — and ``verify_score_view()`` re-gathers the device shards
+bitwise clean; eviction/full-rebuild triggers (layout change, shape
+flip, ``cache.score_refresh_drop``) never serve a stale row; and the
+rescored/reused counter accounting is exact. The jaxpr half of the pin
+(incremental on/off trace the same kernel set) lives in
+tests/test_jaxlint.py with the other fleet invariance proofs.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nomad_tpu.chaos import FaultPlane, FaultSpec, install, uninstall
+from nomad_tpu.device.cache import DeviceStateCache
+from nomad_tpu.scheduler.algorithms import make_kernel
+from nomad_tpu.scheduler.cp import build_cp_asks
+from nomad_tpu.scheduler.hetero import build_mixed_asks, build_mixed_fleet
+from nomad_tpu.utils import backend
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plane():
+    yield
+    uninstall()
+
+
+@pytest.fixture
+def mesh_env(monkeypatch):
+    def activate(spec):
+        monkeypatch.setenv("NOMAD_TPU_MESH", spec)
+        backend.reset_mesh()
+        return backend.get_mesh()
+
+    yield activate
+    monkeypatch.delenv("NOMAD_TPU_MESH", raising=False)
+    backend.reset_mesh()
+
+
+@pytest.fixture
+def incr_env(monkeypatch):
+    """Opt a test into the incremental score cache via the env seam;
+    restores the default-off resolution afterwards."""
+
+    def activate(spec="on"):
+        monkeypatch.setenv("NOMAD_TPU_INCREMENTAL", spec)
+        backend.reset_incremental()
+        return backend.incremental_enabled()
+
+    yield activate
+    monkeypatch.delenv("NOMAD_TPU_INCREMENTAL", raising=False)
+    backend.reset_incremental()
+
+
+# -- workload builders --------------------------------------------------------
+
+ALGOS = ("binpack", "spread", "hetero-maxmin", "cp-pack")
+MESH_SPECS = ("2,4", "1,8", "4,2")
+
+
+def _workload(algo: str, seed: int):
+    """(cluster, asks) for one algorithm family — fresh arrays per call
+    so the on/off arms never share a mutated ``used``."""
+    if algo in ("binpack", "spread"):
+        from nomad_tpu.analysis.jaxlint.exercise import _ask, _cluster
+
+        ct = _cluster()
+        return ct, [_ask(ct, f"a{seed}", 3), _ask(ct, f"b{seed}", 2)]
+    ct = build_mixed_fleet(48, seed=seed)
+    if algo == "cp-pack":
+        return ct, build_cp_asks(ct, 6, 4, seed=seed + 1)
+    return ct, build_mixed_asks(ct, 6, 4, seed=seed + 1)
+
+
+def _run_passes(algo: str, seed: int, incremental: bool, passes: int = 3):
+    """Drive ``passes`` kernel passes with deterministic alloc churn
+    between them; returns per-pass (rows, score-uint32-view) lists plus
+    the cache (None for the off arm)."""
+    ct, asks = _workload(algo, seed)
+    cache = None
+    if incremental:
+        cache = DeviceStateCache()
+        ct.score_cache = cache
+    kernel = make_kernel(algo)
+    rng = np.random.default_rng(seed)
+    out = []
+    for p in range(passes):
+        results = kernel.place(ct, asks)
+        out.append([
+            None if r is None else (
+                np.asarray(r.node_rows).copy(),
+                np.asarray(r.scores, dtype=np.float32)
+                .view(np.uint32).copy(),
+            )
+            for r in results
+        ])
+        if cache is not None:
+            cache.score_commit()
+        # churn: a couple of rows' usage moves, exactly like alloc
+        # commits between scheduler passes
+        for _ in range(2):
+            row = int(rng.integers(0, ct.num_nodes))
+            ct.used[row, 0] += np.float32(16.0 * (p + 1))
+    return out, cache
+
+
+# -- bit-identity: incremental on == off, byte for byte ----------------------
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("algo", ALGOS)
+    @pytest.mark.parametrize("spec", MESH_SPECS)
+    def test_incremental_matches_scratch_bitwise(
+        self, algo, spec, mesh_env, incr_env
+    ):
+        """Across meshes × kernel families × multi-pass churn, rows and
+        scores (uint32 views) from the cached-score path must equal the
+        from-scratch path byte for byte."""
+        mesh_env(spec)
+        seed = 7
+        ref, _ = _run_passes(algo, seed, incremental=False)
+        incr_env("on")
+        got, cache = _run_passes(algo, seed, incremental=True)
+        assert len(got) == len(ref)
+        for p, (rp, gp) in enumerate(zip(ref, got)):
+            assert len(gp) == len(rp)
+            for lane, (r, g) in enumerate(zip(rp, gp)):
+                assert (r is None) == (g is None), (p, lane)
+                if r is None:
+                    continue
+                np.testing.assert_array_equal(g[0], r[0], err_msg=f"{p}/{lane}")
+                np.testing.assert_array_equal(g[1], r[1], err_msg=f"{p}/{lane}")
+        # the on arm really took the incremental path, and its device
+        # shards re-gather bitwise equal to the generation mirror
+        c = cache.device_counters()
+        assert c["score_full_rebuilds"] >= 1
+        assert c["score_rows_reused"] > 0
+        assert cache.verify_score_view() == []
+
+    @pytest.mark.parametrize("seed", [3, 11, 29])
+    def test_degenerate_mesh_bit_identity(self, seed, incr_env):
+        """No mesh (single-device whole-tensor path): same pin."""
+        ref, _ = _run_passes("binpack", seed, incremental=False)
+        incr_env("on")
+        got, cache = _run_passes("binpack", seed, incremental=True)
+        for rp, gp in zip(ref, got):
+            for r, g in zip(rp, gp):
+                np.testing.assert_array_equal(g[0], r[0])
+                np.testing.assert_array_equal(g[1], r[1])
+        assert cache.verify_score_view() == []
+
+
+# -- counter accounting exactness --------------------------------------------
+
+
+class TestCounterAccounting:
+    def test_rescored_reused_exact(self, mesh_env, incr_env):
+        """16-row cluster, one kernel family, one score view per pass:
+        pass 1 is a full rebuild (every row rescored), a 1-row churn
+        makes pass 2 rescore exactly 1 and reuse exactly 15."""
+        from nomad_tpu.analysis.jaxlint.exercise import _ask, _cluster
+
+        mesh_env("2,4")
+        incr_env("on")
+        ct = _cluster()
+        cache = DeviceStateCache()
+        ct.score_cache = cache
+        asks = [_ask(ct, "a", 3), _ask(ct, "b", 2)]
+        kernel = make_kernel("binpack")
+
+        kernel.place(ct, asks)
+        cache.score_commit()
+        c = cache.device_counters()
+        assert c["score_full_rebuilds"] == 1
+        assert c["score_rows_rescored"] == 16
+        assert c["score_rows_reused"] == 0
+        assert c["score_patch_uploads"] == 0
+        assert c["score_swaps"] == 1
+        assert c["score_gen"] == 1
+
+        ct.used[0, 0] += 128.0
+        kernel.place(ct, asks)
+        cache.score_commit()
+        c = cache.device_counters()
+        assert c["score_full_rebuilds"] == 1
+        assert c["score_rows_rescored"] == 17  # 16 + the 1 dirty row
+        assert c["score_rows_reused"] == 15
+        assert c["score_patch_uploads"] == 1
+        assert c["score_swaps"] == 2
+        assert c["score_gen"] == 2
+
+        # clean pass: zero dirt, full reuse, NO generation bump
+        kernel.place(ct, asks)
+        cache.score_commit()
+        c = cache.device_counters()
+        assert c["score_rows_rescored"] == 17
+        assert c["score_rows_reused"] == 31  # +16
+        assert c["score_swaps"] == 2
+        assert c["score_gen"] == 2
+        assert cache.verify_score_view() == []
+
+    def test_off_mode_touches_nothing(self):
+        """Default-off: no score state, no counters, view is None."""
+        from nomad_tpu.analysis.jaxlint.exercise import _ask, _cluster
+
+        ct = _cluster()
+        cache = DeviceStateCache()
+        ct.score_cache = cache
+        make_kernel("binpack").place(ct, [_ask(ct, "a", 3)])
+        c = cache.device_counters()
+        assert c["score_full_rebuilds"] == 0
+        assert c["score_rows_rescored"] == 0
+        assert c["score_gen"] == 0
+        assert cache.verify_score_view() is None
+
+
+# -- generation protocol: swap ordering, abort, self-healing -----------------
+
+
+class TestGenerationProtocol:
+    def _view(self, cache, ct, used):
+        return cache.score_view(ct, used)
+
+    def test_swap_ordering_and_zero_dirty_no_swap(self, incr_env):
+        from nomad_tpu.analysis.jaxlint.exercise import _cluster
+
+        incr_env("on")
+        ct = _cluster()
+        cache = DeviceStateCache()
+        u1 = ct.used.copy()
+        self._view(cache, ct, u1)
+        assert cache.device_counters()["score_gen"] == 1
+        cache.score_commit()
+        assert cache._score is not None and cache._score.gen == 1
+        assert cache._score_staged is None
+        # identical bytes: staged rides the same generation, commit is
+        # a no-op swap
+        self._view(cache, ct, u1)
+        cache.score_commit()
+        assert cache._score.gen == 1
+        assert cache.device_counters()["score_swaps"] == 1
+        # dirty bytes: staged gen 2, commit swaps
+        u2 = u1.copy()
+        u2[3, 1] += 7.0
+        self._view(cache, ct, u2)
+        assert cache._score.gen == 1  # committed view unchanged pre-swap
+        cache.score_commit()
+        assert cache._score.gen == 2
+        assert cache.verify_score_view() == []
+
+    def test_abort_drops_staged_and_next_pass_self_heals(self, incr_env):
+        from nomad_tpu.analysis.jaxlint.exercise import _cluster
+
+        incr_env("on")
+        ct = _cluster()
+        cache = DeviceStateCache()
+        u1 = ct.used.copy()
+        self._view(cache, ct, u1)
+        cache.score_commit()
+        u2 = u1.copy()
+        u2[5, 0] += 3.0
+        self._view(cache, ct, u2)
+        cache.score_abort()  # the pass died before its commit
+        assert cache._score_staged is None
+        assert cache._score.gen == 1
+        # next pass diffs against the COMMITTED mirror and re-uploads
+        # the aborted dirt — serving u2 correctly, never u1's row 5
+        dev = self._view(cache, ct, u2)
+        np.testing.assert_array_equal(np.asarray(dev), u2)
+        cache.score_commit()
+        assert cache._score.gen == 2
+        assert cache.verify_score_view() == []
+
+    def test_kill_mid_commit_chaos_run_holds_law_12(self):
+        """Server-level: a chaos-killed commit thread must leave the
+        score plane consistent — the worker's commit finally still
+        promotes the staged generation, whose mirror is exact for the
+        bytes it was built from, and the next pass's bitwise diff
+        re-uploads whatever the killed commit never landed. Law 12
+        (score half) verifies the shards bitwise during check_cluster."""
+        from nomad_tpu.chaos.runner import run_chaos
+
+        run = run_chaos(
+            seed=23,
+            steps=60,
+            schedule=[
+                FaultSpec("worker.commit", 0, "kill"),
+                FaultSpec("worker.commit", 2, "kill"),
+            ],
+            incremental=True,
+        )
+        assert run.report.ok, run.report.to_dict()
+        dc = run.report.info.get("device_cache", {})
+        assert dc.get("score_full_rebuilds", 0) >= 1
+        assert dc.get("score_swaps", 0) >= 1
+        # the run really injected the kills (index 0 consumed at least)
+        assert any(
+            site == "worker.commit" for site, _i, _a in run.triggered
+        ), run.triggered
+        # env seam restored for the rest of the session
+        assert os.environ.get("NOMAD_TPU_INCREMENTAL") in (None, "off")
+        assert not backend.incremental_enabled()
+
+
+# -- eviction / full-rebuild triggers ----------------------------------------
+
+
+class TestRebuildTriggers:
+    def test_shape_flip_rebuilds(self, incr_env):
+        from nomad_tpu.analysis.jaxlint.exercise import _cluster
+
+        incr_env("on")
+        ct = _cluster()
+        cache = DeviceStateCache()
+        cache.score_view(ct, ct.used)
+        cache.score_commit()
+        # a grown node bucket (layout change flips the row count):
+        # every cached partial is row-misaligned — full rebuild
+        bigger = np.zeros((ct.padded_n * 2, ct.used.shape[1]), np.float32)
+        bigger[: ct.padded_n] = ct.used
+        dev = cache.score_view(ct, bigger)
+        np.testing.assert_array_equal(np.asarray(dev), bigger)
+        assert cache.device_counters()["score_full_rebuilds"] == 2
+        assert cache.verify_score_view() == []
+
+    def test_layout_gen_bump_rebuilds(self, incr_env):
+        from dataclasses import replace
+
+        from nomad_tpu.analysis.jaxlint.exercise import _cluster
+
+        incr_env("on")
+        ct = _cluster()
+        cache = DeviceStateCache()
+        cache.score_view(ct, ct.used)
+        cache.score_commit()
+        # same shape, new layout generation (a full reflatten re-sorts
+        # rows — e.g. a class flip): cached rows are misaligned even
+        # though nothing else changed
+        ct2 = replace(ct, layout_gen=ct.layout_gen + 1)
+        cache.score_view(ct2, ct.used)
+        assert cache.device_counters()["score_full_rebuilds"] == 2
+
+    def test_chaos_score_refresh_drop_recovers_via_rebuild(
+        self, mesh_env, incr_env
+    ):
+        """A dropped dirty-slice patch must NOT serve a stale row:
+        recovery is a whole-tensor score rebuild on the same access
+        (the mesh.shard_refresh_drop discipline, score half)."""
+        from nomad_tpu.analysis.jaxlint.exercise import _cluster
+
+        mesh_env("2,4")
+        incr_env("on")
+        ct = _cluster()
+        cache = DeviceStateCache()
+        cache.score_view(ct, ct.used)
+        cache.score_commit()
+        dirty = ct.used.copy()
+        dirty[2, 0] += 55.0
+        plane = FaultPlane(
+            schedule=[FaultSpec("cache.score_refresh_drop", 0, "drop")]
+        )
+        install(plane)
+        try:
+            dev = cache.score_view(ct, dirty)
+        finally:
+            uninstall()
+        c = cache.device_counters()
+        assert c["score_full_rebuilds"] == 2
+        assert c["score_patch_uploads"] == 0
+        np.testing.assert_array_equal(np.asarray(dev), dirty)
+        assert cache.verify_score_view() == []
+        assert ("cache.score_refresh_drop", 0, "drop") in plane.triggered
+
+    def test_invalidate_evicts_score_state(self, incr_env):
+        from nomad_tpu.analysis.jaxlint.exercise import _cluster
+
+        incr_env("on")
+        ct = _cluster()
+        cache = DeviceStateCache()
+        cache.score_view(ct, ct.used)
+        cache.score_commit()
+        cache.invalidate()
+        assert cache.verify_score_view() is None
+        assert cache.device_counters()["score_gen"] == 0
+
+
+# -- observability surfaces ---------------------------------------------------
+
+
+class TestSurfaces:
+    def test_device_counters_schema(self):
+        c = DeviceStateCache().device_counters()
+        for key in (
+            "score_rows_rescored", "score_rows_reused",
+            "score_patch_uploads", "score_full_rebuilds",
+            "score_swaps", "score_gen", "pipeline_overlap_ms",
+        ):
+            assert key in c, key
+
+    def test_slo_report_carries_device_cache_block(self):
+        from nomad_tpu.obs.slo import (
+            SLO_SCHEMA,
+            SloCollector,
+            SloTargets,
+            build_report,
+            slo_schema_of,
+        )
+
+        rep = build_report(SloCollector(), SloTargets())
+        assert slo_schema_of(rep) == SLO_SCHEMA
+        assert rep["device_cache"] == {
+            "score_rows_rescored": 0,
+            "score_rows_reused": 0,
+            "pipeline_overlap_ms": 0.0,
+        }
+
+    def test_soak_canonical_carries_incremental_flag(self):
+        from nomad_tpu.obs.loadgen import SoakRun
+
+        run = SoakRun(
+            seed=1, seconds=1.0, rate=1.0, nodes=4, batch_workers=1,
+            schedule_rows=[], targets=__import__(
+                "nomad_tpu.obs.slo", fromlist=["SloTargets"]
+            ).SloTargets(),
+            slo={}, report=None, workload={}, duration_s=0.0,
+            incremental=True,
+        )
+        assert run.canonical()["incremental"] is True
+
+    def test_note_overlap_accumulates(self):
+        cache = DeviceStateCache()
+        cache.note_overlap(2.5)
+        cache.note_overlap(-1.0)  # clamped
+        cache.note_overlap(1.25)
+        assert cache.device_counters()["pipeline_overlap_ms"] == 3.75

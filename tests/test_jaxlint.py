@@ -469,6 +469,22 @@ class TestInvarianceDiffer:
             assert v["added_specs"] == [], (k, v)
             assert v["fingerprints_equal"], (k, v)
 
+    def test_incremental_on_off_adds_no_traced_program(self, proofs):
+        """The jaxpr half of the incremental-rescoring bit-identity pin
+        (device/cache.py): serving ``used`` from the persisted score
+        state must trace the identical kernel set — zero new traces,
+        zero new specs, every fingerprint unchanged."""
+        rep = proofs["incremental"]
+        assert rep["ok"], rep
+        assert "place_closed_form_kernel" in rep["kernels"]
+        for k, v in rep["kernels"].items():
+            assert v["added_traces"] == 0, (k, v)
+            assert v["added_specs"] == [], (k, v)
+            assert v["fingerprints_equal"], (k, v)
+
+    def test_incremental_differ_restores_ambient_state(self, proofs):
+        assert os.environ.get("NOMAD_TPU_INCREMENTAL") in (None, "off")
+
     def test_mesh_on_off_jaxprs_identical(self, proofs):
         rep = proofs["mesh"]
         assert not rep.get("skipped"), (

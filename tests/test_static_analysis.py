@@ -30,6 +30,7 @@ from nomad_tpu.analysis.rules.laneowner import LaneOwnerDiscipline
 from nomad_tpu.analysis.rules.lockfields import LockDiscipline
 from nomad_tpu.analysis.rules.mergedsubmit import MergedSubmitDiscipline
 from nomad_tpu.analysis.rules.planfreeze import PlanMutationAfterSubmit
+from nomad_tpu.analysis.rules.scorestate import ScoreStateDiscipline
 from nomad_tpu.analysis.rules.shardingseam import ShardingSeamDiscipline
 from nomad_tpu.analysis.rules.solverseam import SolverSeamDiscipline
 from nomad_tpu.analysis.rules.spans import SpanCoverage
@@ -932,6 +933,85 @@ class TestNTA017:
         assert findings == [], "\n".join(f.render() for f in findings)
 
 
+class TestNTA019:
+    def test_direct_attr_write_triggers(self):
+        src = (
+            "def refresh(state, rows):\n"
+            "    state.used_host = rows\n"
+        )
+        fs = run(src, "nomad_tpu/device/foo.py", ScoreStateDiscipline)
+        assert rule_ids(fs) == ["NTA019"]
+        assert "used_host" in fs[0].message
+
+    def test_subscripted_write_triggers(self):
+        src = (
+            "def patch(state, i, row):\n"
+            "    state.used_host[i] = row\n"
+        )
+        fs = run(src, "nomad_tpu/scheduler/foo.py", ScoreStateDiscipline)
+        assert rule_ids(fs) == ["NTA019"]
+
+    def test_augmented_write_triggers(self):
+        src = (
+            "def bump(ct):\n"
+            "    ct.score_cache += 1\n"
+        )
+        fs = run(src, "nomad_tpu/device/foo.py", ScoreStateDiscipline)
+        assert rule_ids(fs) == ["NTA019"]
+
+    def test_del_triggers(self):
+        src = (
+            "def evict(state):\n"
+            "    del state.used_dev\n"
+        )
+        fs = run(src, "nomad_tpu/device/foo.py", ScoreStateDiscipline)
+        assert rule_ids(fs) == ["NTA019"]
+
+    def test_unprotected_attr_is_clean(self):
+        src = (
+            "def note(state):\n"
+            "    state.counter = 3\n"
+            "    state.rows[0] = 1\n"
+        )
+        assert run(
+            src, "nomad_tpu/device/foo.py", ScoreStateDiscipline
+        ) == []
+
+    def test_refresh_api_owner_is_exempt(self):
+        src = (
+            "def _score_rebuild_locked(self, host):\n"
+            "    self._score.used_host = host\n"
+        )
+        assert run(
+            src, "nomad_tpu/device/cache.py", ScoreStateDiscipline
+        ) == []
+
+    def test_attachment_point_declaration_is_exempt(self):
+        src = (
+            "def tensors(self, out, cache):\n"
+            "    out.score_cache = cache\n"
+        )
+        assert run(
+            src, "nomad_tpu/device/flatten.py", ScoreStateDiscipline
+        ) == []
+
+    def test_outside_scope_is_clean(self):
+        src = "def f(x):\n    x.used_host = 1\n"
+        assert run(
+            src, "nomad_tpu/obs/foo.py", ScoreStateDiscipline
+        ) == []
+
+    def test_whole_package_at_head_is_clean(self):
+        """Score state mutates only through the DeviceStateCache
+        refresh API: zero direct writes to ratchet."""
+        findings = [
+            f
+            for f in lint.run_lint(REPO_ROOT, rules=[ScoreStateDiscipline()])
+            if f.rule == "NTA019"
+        ]
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
 # -- suppression + fingerprints --------------------------------------------
 
 
@@ -1003,6 +1083,7 @@ class TestBaselineRatchet:
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
             "NTA007", "NTA008", "NTA009", "NTA010", "NTA011", "NTA012",
             "NTA013", "NTA014", "NTA015", "NTA016", "NTA017", "NTA018",
+            "NTA019",
         ]
 
 
