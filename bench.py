@@ -1149,6 +1149,48 @@ def main():
         if not d["ok"]:
             sys.exit(1)
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "gang":
+        # gang scheduling A/B: greedy binpack (gang-blind, fragments
+        # multi-group jobs across racks) vs cp-gang (topology-priced
+        # all-or-nothing placement) on one seeded topology fleet.
+        # Canonical, seeded, byte-reproducible JSON; gates (exit 1) on
+        # binpack fragmenting at least one gang, cp-gang placing every
+        # gang all-or-nothing with its topology constraint satisfied at
+        # no aggregate-objective loss, and the gang kernel being
+        # byte-identical to its NumPy host oracle across two seeds
+        # (scheduler/cp.py run_gang_ab).
+        fallback = _ensure_live_backend()
+        import jax
+
+        from nomad_tpu.scheduler.cp import run_gang_ab
+
+        n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+        n_jobs = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+        groups = int(sys.argv[4]) if len(sys.argv) > 4 else 3
+        d = run_gang_ab(
+            n_nodes=n_nodes, n_jobs=n_jobs, groups=groups, seed=42
+        )
+        d["mesh"] = mesh_block(n_nodes)
+        d["kernel_fingerprints"] = kernel_fingerprints_block()
+        print(
+            json.dumps(
+                {
+                    "metric": "cp-gang aggregate objective delta vs "
+                    f"binpack ({n_nodes} nodes, {n_jobs} jobs x "
+                    f"{groups} groups)",
+                    "value": d["ab"]["objective_delta"],
+                    "unit": "score",
+                    "vs_baseline": 0.0,
+                    "platform": jax.devices()[0].platform,
+                    "fallback": fallback,
+                    "detail": d,
+                },
+                sort_keys=True,
+            )
+        )
+        if not d["ok"]:
+            sys.exit(1)
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "calib":
         # calibration A/B: declared vs learned throughputs on one seeded
         # mixed fleet. The estimator learns per-(device class × job

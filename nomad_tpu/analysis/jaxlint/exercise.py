@@ -238,6 +238,39 @@ def run_cp() -> None:
     )
 
 
+def run_cp_gang() -> None:
+    import numpy as np
+
+    from ...device.cp import cp_gang_place_kernel
+
+    g, n, levels = 2, N_NODES, 4
+    capacity = np.full((n, D), 16000.0, dtype=np.float32)
+    used0 = capacity * 0.1
+    asks = np.full((g, D), 250.0, dtype=np.float32)
+    counts = np.full(g, 2, dtype=np.int32)
+    eligible = np.ones((g, n), dtype=bool)
+    scores = np.linspace(
+        0.1, 0.9, g * n, dtype=np.float32
+    ).reshape(g, n)
+    prio = np.full(g, 50.0, dtype=np.float32)
+    job_counts = np.zeros((g, n), dtype=np.int32)
+    distinct = np.zeros(g, dtype=bool)
+    jobgrp = np.zeros(g, dtype=np.int32)
+    gang = np.ones(g, dtype=np.int32)  # both groups in gang 1
+    w_rack = np.full(g, 1.0, dtype=np.float32)
+    w_pod = np.zeros(g, dtype=np.float32)
+    rack_oh = np.zeros((n, levels), dtype=np.int32)
+    rack_oh[np.arange(n), 1 + np.arange(n) % (levels - 1)] = 1
+    pod_oh = np.zeros((n, 2), dtype=np.int32)
+    pod_oh[:, 1] = 1
+    lam0 = np.zeros(n, dtype=np.float32)
+    cp_gang_place_kernel(
+        capacity, used0, asks, counts, eligible, scores, prio,
+        job_counts, distinct, jobgrp, gang, w_rack, w_pod,
+        rack_oh, pod_oh, lam0, steps=8, max_c=4,
+    )
+
+
 def exercise_fleet(explain: bool = False) -> dict:
     """Run the whole fleet exercise; returns the kernel registry
     afterwards (every production kernel now has a recorded spec)."""
@@ -250,4 +283,5 @@ def exercise_fleet(explain: bool = False) -> dict:
     run_preemption()
     run_hetero()
     run_cp()
+    run_cp_gang()
     return backend.kernel_registry()

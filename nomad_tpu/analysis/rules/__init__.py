@@ -21,6 +21,7 @@ from .solverseam import SolverSeamDiscipline  # noqa: E402
 from .kernelseam import KernelSeamDiscipline  # noqa: E402
 from .provenance import ConstantProvenanceDiscipline  # noqa: E402
 from .scorestate import ScoreStateDiscipline  # noqa: E402
+from .topologyseam import TopologySeamDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -42,6 +43,7 @@ REGISTRY = [
     KernelSeamDiscipline,  # NTA017
     ConstantProvenanceDiscipline,  # NTA018
     ScoreStateDiscipline,  # NTA019
+    TopologySeamDiscipline,  # NTA020
 ]
 
 __all__ = ["REGISTRY"]

@@ -1046,7 +1046,10 @@ class HTTPAgent:
     def handle_hetero_placements(self, method, body, query):
         """GET /v1/operator/scheduler/placements — live allocation counts
         per device class, overall and per job: the observable effect of
-        choosing a hetero-* algorithm (scheduler/hetero.py)."""
+        choosing a hetero-* algorithm (scheduler/hetero.py). Also carries
+        the topology occupancy view (allocs/nodes per rack and per pod,
+        from node.topology) and per-gang intactness — the observable
+        effect of cp-gang and the law-15 atomic-commit seam."""
         if method != "GET":
             raise APIError(405, "method not allowed")
         self._enforce(query, "operator_read")
@@ -1055,16 +1058,49 @@ class HTTPAgent:
         per_class: dict[str, int] = {}
         per_job: dict[str, dict[str, int]] = {}
         nodes_per_class: dict[str, int] = {}
+        per_rack: dict[str, dict[str, int]] = {}
+        per_pod: dict[str, dict[str, int]] = {}
         for node in store.nodes():
             dc = node.device_class
             nodes_per_class[dc] = nodes_per_class.get(dc, 0) + 1
+            topo = getattr(node, "topology", None) or {}
+            rack = per_rack.setdefault(
+                topo.get("rack", ""), {"nodes": 0, "allocs": 0}
+            )
+            pod = per_pod.setdefault(
+                topo.get("pod", ""), {"nodes": 0, "allocs": 0}
+            )
+            rack["nodes"] += 1
+            pod["nodes"] += 1
             for a in store.allocs_by_node(node.id):
                 if a.terminal_status():
                     continue
                 per_class[dc] = per_class.get(dc, 0) + 1
+                rack["allocs"] += 1
+                pod["allocs"] += 1
                 jk = f"{a.namespace}/{a.job_id}"
                 jc = per_job.setdefault(jk, {})
                 jc[dc] = jc.get(dc, 0) + 1
+        gangs: dict[str, dict] = {}
+        for job in store.jobs():
+            gang = getattr(job, "gang", None) or {}
+            members = list(gang.get("groups") or ())
+            if not members or job.stopped():
+                continue
+            desired = job.required_allocs()
+            live = {m: 0 for m in members}
+            for a in store.allocs_by_job(job.namespace, job.id):
+                if not a.terminal_status() and a.task_group in live:
+                    live[a.task_group] += 1
+            gangs[f"{job.namespace}/{job.id}"] = {
+                "members": dict(sorted(live.items())),
+                "desired": {
+                    m: desired.get(m, 0) for m in sorted(members)
+                },
+                "intact": all(
+                    live[m] == desired.get(m, 0) for m in members
+                ),
+            }
         return {
             "scheduler_algorithm": cfg.scheduler_algorithm,
             "nodes_per_class": dict(sorted(nodes_per_class.items())),
@@ -1073,6 +1109,11 @@ class HTTPAgent:
                 k: dict(sorted(v.items()))
                 for k, v in sorted(per_job.items())
             },
+            "topology": {
+                "racks": dict(sorted(per_rack.items())),
+                "pods": dict(sorted(per_pod.items())),
+            },
+            "gangs": dict(sorted(gangs.items())),
         }
 
     def handle_job_versions(self, method, body, query, job_id):
@@ -1657,6 +1698,21 @@ class HTTPAgent:
             # rows patched vs served resident, generation swaps, and
             # the pipeline-overlap wall time the commit thread hid
             "device_cache": self.server.device_cache.device_counters(),
+            # gang scheduling ledger: kernel-level commits/releases
+            # (scheduler/cp.py nomad.cp.gang_*) plus the law-15 atomic
+            # release seam (scheduler/generic.py nomad.gang.*)
+            "gang": self._gang_counters(),
+        }
+
+    @staticmethod
+    def _gang_counters() -> dict:
+        from ..utils.metrics import global_metrics
+
+        counters = global_metrics.snapshot()["counters"]
+        return {
+            k: v
+            for k, v in sorted(counters.items())
+            if k.startswith(("nomad.gang.", "nomad.cp.gang_"))
         }
 
     def handle_agent_resilience(self, method, body, query):

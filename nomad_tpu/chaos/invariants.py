@@ -76,6 +76,13 @@ true no matter which faults fired:
     anchor, and every calibration-table constant is finite with a known
     provenance source — including through ``calib.telemetry_drop``
     starvation windows.
+``gang_atomicity``
+    after quiesce every gang job (structs/job.py ``gang`` stanza) is
+    fully placed or fully absent: its member task groups all run
+    exactly their desired counts, or all run zero — never a striped
+    partial gang. Holds through ``gang.commit_drop`` dropped/killed
+    commits and cp-gang in-pass releases (scheduler/generic.py
+    ``_enforce_gang_atomicity``, invariant law 15).
 """
 
 from __future__ import annotations
@@ -104,6 +111,7 @@ INVARIANTS = (
     "shard_consistency",
     "cp_assignment_conservation",
     "calibration_sanity",
+    "gang_atomicity",
 )
 
 
@@ -582,6 +590,42 @@ def check_cluster(
                 )
         report.info["calibration_by_source"] = tsnap["by_source"]
 
+    # -- gang_atomicity ----------------------------------------------------
+    # Law 15: a gang is fully placed or fully absent. For every live gang
+    # job, each member group runs exactly its desired count or every
+    # member runs zero — a mixed state means a release path (scheduler/
+    # generic.py _enforce_gang_atomicity, or the cp-gang kernel's
+    # release_incomplete_gangs) let a fragment stripe through, including
+    # under gang.commit_drop dropped/killed commits.
+    gang_jobs = 0
+    for job in snap.jobs():
+        gang = getattr(job, "gang", None) or {}
+        members = [m for m in (gang.get("groups") or ())]
+        if not members or job.stopped():
+            continue
+        gang_jobs += 1
+        report.checked["gang_atomicity"] = True
+        desired = job.required_allocs()
+        counts = {}
+        for m in members:
+            counts[m] = sum(
+                1
+                for a in snap.allocs_by_job(job.namespace, job.id)
+                if a.task_group == m and not a.terminal_status()
+            )
+        full = all(counts[m] == desired.get(m, 0) for m in members)
+        absent = all(counts[m] == 0 for m in members)
+        if not (full or absent):
+            report._fail(
+                "gang_atomicity",
+                f"{job.namespace}/{job.id}",
+                "gang striped: member live counts "
+                f"{sorted(counts.items())} vs desired "
+                f"{sorted((m, desired.get(m, 0)) for m in members)} "
+                "(want all-full or all-zero)",
+            )
+    report.info["gang_jobs"] = gang_jobs
+
     # context for the human-facing dump
     from ..resilience.breaker import snapshot_all
 
@@ -593,7 +637,7 @@ def check_cluster(
         if k.startswith((
             "nomad.chaos.", "nomad.resilience.", "nomad.lane.",
             "nomad.overlay.", "nomad.plan.lane", "nomad.plan.cross_lane",
-            "nomad.admission.", "nomad.cp.",
+            "nomad.admission.", "nomad.cp.", "nomad.gang.",
         ))
         or k == "nomad.broker.nack_redelivery_delayed"
         or k.endswith(".swallowed_errors")

@@ -98,6 +98,12 @@ SITES: dict[str, tuple[str, ...]] = {
     # source: default and answer the declared anchor, never a garbage
     # estimate (invariant law 14)
     "calib.telemetry_drop": ("drop",),
+    # gang atomic commit (scheduler/generic.py): drop a healthy gang's
+    # commit — every member must release and the whole gang ride one
+    # blocked eval, never a striped partial plan; a kill mid-commit
+    # leaves the plan unsubmitted (trivially atomic). Invariant law 15:
+    # after quiesce a gang job is fully placed or fully absent.
+    "gang.commit_drop": ("drop", "kill"),
 }
 
 FAULT_KINDS = (
@@ -132,6 +138,8 @@ _HORIZON = {
     "cp.round_perturb": (0.125, 2),
     # hit per score-view access with dirty rows pending (incremental on)
     "cache.score_refresh_drop": (0.125, 2),
+    # hit once per gang-job scheduling pass, not per workload op
+    "gang.commit_drop": (0.125, 2),
     # hit once per estimator input sample (span fan-out rate)
     "calib.telemetry_drop": (1.0, 8),
 }

@@ -127,19 +127,34 @@ def _build_job(seq: int, count: int, priority: int):
 
     j = mock.job(id=f"chaos-job-{seq:04d}", name=f"chaos-job-{seq:04d}")
     j.priority = priority
-    j.task_groups = [
-        TaskGroup(
-            name="web",
+
+    def _tg(name: str) -> TaskGroup:
+        return TaskGroup(
+            name=name,
             count=count,
             tasks=[
                 Task(
-                    name="web",
+                    name=name,
                     driver="exec",
                     resources=Resources(cpu=256, memory_mb=128),
                 )
             ],
         )
-    ]
+
+    if seq % 5 == 4:
+        # every fifth job is a two-group gang: the atomic-commit seam
+        # (law 15, scheduler/generic.py) only gets exercised if gangs
+        # flow through the ordinary op stream — registers, scales, and
+        # deregisters alike — under the same faults as everything else.
+        # Keyed off seq (not an rng draw) so the workload's draw count
+        # per step is unchanged and canonical reports stay comparable.
+        j.task_groups = [_tg("a"), _tg("b")]
+        j.gang = {
+            "groups": ["a", "b"],
+            "colocate": {"level": "rack", "weight": 1.0},
+        }
+    else:
+        j.task_groups = [_tg("web")]
     return j
 
 
@@ -152,7 +167,13 @@ def _drive_workload(server, seed: int, steps: int) -> dict:
     rng = random.Random(f"{seed}:workload")
     attempted: list[str] = []
     seq = 0
-    counts = {"registers": 0, "scales": 0, "deregisters": 0, "rejected": 0}
+    counts = {
+        "registers": 0,
+        "gang_registers": 0,
+        "scales": 0,
+        "deregisters": 0,
+        "rejected": 0,
+    }
 
     def _submit(fn):
         try:
@@ -174,6 +195,8 @@ def _drive_workload(server, seed: int, steps: int) -> dict:
                 lambda: server.register_job(_build_job(seq, count, priority))
             )
             attempted.append(job_id)
+            if seq % 5 == 4:
+                counts["gang_registers"] += 1
             seq += 1
             counts["registers"] += 1
         elif r < 0.85:

@@ -182,6 +182,20 @@ def cmd_job_plan(args) -> int:
                 )
             else:
                 print(f"    {tg}: {m}")
+    g = out.get("gang")
+    if g:
+        verdict = (
+            "all members place"
+            if g.get("feasible")
+            else "infeasible — whole gang would release (all-or-nothing)"
+        )
+        members = ", ".join(
+            f"{m}=+{row.get('place', 0)}"
+            for m, row in sorted(g.get("members", {}).items())
+        )
+        print(f"  gang: {verdict} ({members})")
+        for r in g.get("reasons", []):
+            print(f"    reason: {r}")
     if getattr(args, "verbose", False):
         # -verbose: per-group candidate score tables from the dry run's
         # explain seam (scheduler/annotate.py)
@@ -1304,6 +1318,30 @@ def cmd_operator_placements(args) -> int:
                 for dc, cnt in classes.items()
             )
             print(f"  {jk}: {parts}")
+    topo = rep.get("topology", {})
+    for level in ("racks", "pods"):
+        rows = topo.get(level, {})
+        # a single "" bucket means the fleet carries no coordinates at
+        # this level — nothing to show
+        if not rows or set(rows) == {""}:
+            continue
+        print(f"\n{level.capitalize():<16} {'Nodes':>6} {'Allocs':>7}")
+        for name, row in sorted(rows.items()):
+            label = name or "(none)"
+            print(
+                f"{label:<16} {row.get('nodes', 0):>6} "
+                f"{row.get('allocs', 0):>7}"
+            )
+    gangs = rep.get("gangs", {})
+    if gangs:
+        print("\nGangs:")
+        for jk, g in sorted(gangs.items()):
+            state = "intact" if g.get("intact") else "released"
+            parts = ", ".join(
+                f"{m}={cnt}/{g.get('desired', {}).get(m, 0)}"
+                for m, cnt in sorted(g.get("members", {}).items())
+            )
+            print(f"  {jk}: {state} ({parts})")
     return 0
 
 
@@ -1581,7 +1619,9 @@ def build_parser() -> argparse.ArgumentParser:
     sched.add_argument("--algorithm", choices=_algos())
     sched.set_defaults(fn=cmd_operator_scheduler)
     placements = op.add_parser(
-        "placements", help="per-device-class allocation counts"
+        "placements",
+        help="per-device-class and per-rack/pod allocation counts, "
+             "plus gang intactness",
     )
     placements.set_defaults(fn=cmd_operator_placements)
     dbg = op.add_parser("debug", help="capture a support bundle")

@@ -26,6 +26,7 @@ Detector parity map (reference file → here):
 from __future__ import annotations
 
 import glob
+import hashlib
 import multiprocessing
 import os
 import platform
@@ -278,6 +279,68 @@ def _fp_device_class(node: Node, ctx: dict) -> None:
         node.attributes["device.class"] = slug
 
 
+TOPOLOGY_LEVELS = ("rack", "pod", "ici")
+
+
+def normalize_topology(spec: str) -> dict[str, str]:
+    """Parse a ``rack=r03,pod=p1,ici=2.1`` coordinate spec into a
+    topology dict. Unknown levels and malformed entries are dropped —
+    an operator typo degrades to topology-less, never to a crash."""
+    topo: dict[str, str] = {}
+    for entry in str(spec).split(","):
+        if "=" not in entry:
+            continue
+        level, _, value = entry.partition("=")
+        level = level.strip().lower()
+        value = value.strip().lower()
+        if level in TOPOLOGY_LEVELS and value:
+            topo[level] = value
+    return topo
+
+
+def _fp_topology(node: Node, ctx: dict) -> None:
+    """Topology fingerprint: rack/pod/ICI coordinates for gang-aware
+    placement. Precedence mirrors _fp_device_class: an explicit
+    ``NOMAD_TPU_TOPOLOGY`` operator override wins, then pre-configured
+    coordinates (client config), then — when an accelerator was detected
+    (``tpu.type`` from _fp_tpu) — a deterministic derivation from the
+    node name, so a fleet brought up without cabling data still gets
+    stable, restart-invariant coordinates. Hosts with no accelerator and
+    no override stay topology-less ({}) so existing clusters schedule
+    bit-identically until an operator opts a fleet in."""
+    override = os.environ.get("NOMAD_TPU_TOPOLOGY", "")
+    if override:
+        topo = normalize_topology(override)
+        if topo:
+            node.topology = topo
+            for level, value in topo.items():
+                node.attributes[f"topology.{level}"] = value
+        return
+    if node.topology:
+        # pre-configured (client config) — keep, but surface as attrs
+        for level, value in node.topology.items():
+            node.attributes[f"topology.{level}"] = value
+        return
+    if not node.attributes.get("tpu.type", ""):
+        return
+    # derive stable coordinates from the node identity: 16 racks of a
+    # 4-pod fabric, ICI coordinate = (pod, rack-within-pod). blake2b of
+    # the name (not the uuid) so a re-registered host keeps its slot.
+    h = int.from_bytes(
+        hashlib.blake2b((node.name or node.id).encode(), digest_size=4).digest(),
+        "big",
+    )
+    rack = h % 16
+    pod = (h >> 8) % 4
+    node.topology = {
+        "rack": f"r{rack:02d}",
+        "pod": f"p{pod}",
+        "ici": f"{pod}.{rack % 4}",
+    }
+    for level, value in node.topology.items():
+        node.attributes[f"topology.{level}"] = value
+
+
 DETECTORS = (
     _fp_cpu,
     _fp_memory,
@@ -291,6 +354,7 @@ DETECTORS = (
     _fp_nomad,
     _fp_tpu,
     _fp_device_class,  # after _fp_tpu: consumes its tpu.type attribute
+    _fp_topology,  # after _fp_tpu: gates derivation on its tpu.type
 )
 
 

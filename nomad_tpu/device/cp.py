@@ -280,3 +280,289 @@ def oracle_cp_place(
         rounds += int(progress)
         it += 1
     return choices, choice_scores, used, rounds, lam
+
+
+# -- gang/topology extension (separate kernel: zero added retraces and
+# guaranteed bit-identity on the gang-less path, which never enters here) ----
+
+
+def topo_onehot(ids: np.ndarray, width: int) -> np.ndarray:
+    """i32[N, W] one-hot of per-node topology level ids with id 0 (the
+    coordinate-less "") zeroed out: a node without a coordinate is
+    adjacent to nothing, not to every other bare node. ``width`` is the
+    bucket-padded vocab size (static kernel dim)."""
+    n = ids.shape[0]
+    oh = np.zeros((n, width), dtype=np.int32)
+    mask = ids > 0
+    oh[np.arange(n)[mask], ids[mask]] = 1
+    return oh
+
+
+def _cp_gang_same(gang):
+    """i32[G, G] gang co-membership, INCLUDING self (a member's own
+    instances attract/repel each other too — the ICI-adjacent-slice
+    case). Gang id 0 = not in any gang."""
+    xp = np if isinstance(gang, np.ndarray) else jnp
+    return (
+        (gang[:, None] == gang[None, :]) & (gang[:, None] > 0)
+    ).astype(xp.int32)
+
+
+def _cp_topo_mates(same_gang, assigned, level_oh):
+    """i32[G, N]: for each group row, how many gang-mate instances are
+    already committed on nodes sharing each node's coordinate at one
+    topology level. Three integer matmuls — exact and order-free:
+    per-node mate counts → per-coordinate totals → broadcast back."""
+    per_node = same_gang @ assigned  # i32[G, N]
+    per_level = per_node @ level_oh  # i32[G, W]
+    return per_level @ level_oh.T  # i32[G, N]
+
+
+def _cp_gang_priced(scores, lam, sib, topo):
+    """f32[G, N] priced utilities with the signed topology term added
+    (elementwise, fixed order — bitwise portable)."""
+    xp = np if isinstance(scores, np.ndarray) else jnp
+    return scores - lam[None, :] - ANTI * sib.astype(xp.float32) + topo
+
+
+# topology weights quantize to this binary grid so the weighted mate
+# sum accumulates in i32 (exact, fusion-proof — an f32 a*w1 + b*w2
+# leaves XLA free to contract into an FMA, and whether it does varies
+# with the sharding, a 1-ulp portability leak) and rescales by an
+# exact power of two
+TOPO_WEIGHT_SCALE = 256
+
+
+def _cp_topo_quant(w):
+    """i32[G] topology weights on the 1/256 grid (round-half-even,
+    matching np.round/jnp.round on both hosts)."""
+    xp = np if isinstance(w, np.ndarray) else jnp
+    return xp.round(w * TOPO_WEIGHT_SCALE).astype(xp.int32)
+
+
+def _cp_topo_term(q_rack, q_pod, mates_rack, mates_pod):
+    """f32[G, N] signed topology term: all-integer weighted sum, then
+    one exact power-of-two rescale — bitwise identical under any mesh
+    partitioning."""
+    xp = np if isinstance(mates_rack, np.ndarray) else jnp
+    acc = q_rack[:, None] * mates_rack + q_pod[:, None] * mates_pod
+    return acc.astype(xp.float32) * xp.float32(1.0 / TOPO_WEIGHT_SCALE)
+
+
+@functools.partial(
+    traced_jit, retrace_budget=16, static_argnames=("steps", "max_c")
+)
+def cp_gang_place_kernel(
+    capacity,  # f32[N, D]
+    used0,  # f32[N, D]
+    asks,  # f32[G, D]
+    counts,  # i32[G]
+    eligible,  # bool[G, N]
+    scores,  # f32[G, N]
+    prio,  # f32[G]
+    job_counts,  # i32[G, N]
+    distinct,  # bool[G]
+    jobgrp,  # i32[G]
+    gang,  # i32[G] gang ids (0 = not ganged)
+    w_rack,  # f32[G] signed rack-level topology weight (+colocate/−spread)
+    w_pod,  # f32[G] signed pod-level topology weight
+    rack_oh,  # i32[N, R] one-hot rack ids (col 0 zeroed)
+    pod_oh,  # i32[N, P] one-hot pod ids (col 0 zeroed)
+    lam0,  # f32[N]
+    steps: int,
+    max_c: int,
+):
+    """cp_place_kernel + gang topology pricing + reservation holds.
+
+    Two additions to the round: (1) priced utility gains a signed
+    topology term — gang-mate instances already reserved on same-rack/
+    same-pod nodes attract (colocate, +w) or repel (spread, −w) further
+    members, so the first member to land seeds the rack the rest of the
+    gang follows into; (2) a gang member's wins are RESERVATIONS, not
+    final placements — they hold capacity inside the loop (feasibility
+    stays exact) but a gang whose members cannot all reach their counts
+    releases every member's reservations in the host post-pass
+    (``release_incomplete_gangs``), with the λ prices carrying out of
+    the pass untouched. Committing per-round only when every member won
+    simultaneously would deadlock: members of one gang share identical
+    score rows, claim the same argmax node, and at most one can win any
+    round. Returns the cp_place_kernel tuple plus ``waits`` i32[G]:
+    rounds a group was active and claimable but lost its node (the
+    explain release_rounds provenance)."""
+    g, n = scores.shape
+    arange_g = jnp.arange(g)
+    arange_n = jnp.arange(n)
+    same_gang = _cp_gang_same(gang)
+    q_rack = _cp_topo_quant(w_rack)
+    q_pod = _cp_topo_quant(w_pod)
+
+    def cond(carry):
+        it, progress = carry[0], carry[1]
+        return (it < steps) & progress
+
+    def body(carry):
+        (it, _, rounds, used, placed, assigned, choices, choice_scores,
+         lam, waits) = carry
+        sib_all, sib_other = _cp_siblings(jobgrp, assigned)
+        feas = _cp_feasible(
+            capacity, used, asks, eligible, job_counts, sib_all, distinct
+        )
+        active = placed < counts
+        mates_rack = _cp_topo_mates(same_gang, assigned, rack_oh)
+        mates_pod = _cp_topo_mates(same_gang, assigned, pod_oh)
+        topo = _cp_topo_term(q_rack, q_pod, mates_rack, mates_pod)
+        umask = jnp.where(
+            feas, _cp_gang_priced(scores, lam, sib_other, topo), _NEG_INF
+        )
+        claim, claimable, won, win, has, claims = _cp_winners(
+            umask, feas, active, prio, arange_g, arange_n
+        )
+        waits = waits + (claimable & ~won).astype(jnp.int32)
+        delta = jnp.where(has[:, None], asks[win], jnp.float32(0.0))
+        used = used + delta
+        slot = jnp.minimum(placed, max_c - 1)
+        old_c = choices[arange_g, slot]
+        old_s = choice_scores[arange_g, slot]
+        choices = choices.at[arange_g, slot].set(
+            jnp.where(won, claim, old_c)
+        )
+        choice_scores = choice_scores.at[arange_g, slot].set(
+            jnp.where(won, scores[arange_g, claim], old_s)
+        )
+        onehot = (won[:, None] & (claim[:, None] == arange_n[None, :]))
+        assigned = assigned + onehot.astype(jnp.int32)
+        placed = placed + won.astype(jnp.int32)
+        lam = lam + ETA * jnp.maximum(claims - 1, 0).astype(jnp.float32)
+        lam = jnp.where(
+            claims == 0, jnp.maximum(lam - ETA, jnp.float32(0.0)), lam
+        )
+        progress = jnp.any(claimable)
+        rounds = rounds + progress.astype(jnp.int32)
+        return (it + 1, progress, rounds, used, placed, assigned,
+                choices, choice_scores, lam, waits)
+
+    carry = (
+        jnp.int32(0),
+        jnp.bool_(True),
+        jnp.int32(0),
+        used0,
+        jnp.zeros(g, dtype=jnp.int32),
+        jnp.zeros((g, n), dtype=jnp.int32),
+        jnp.full((g, max_c), -1, dtype=jnp.int32),
+        jnp.zeros((g, max_c), dtype=jnp.float32),
+        lam0,
+        jnp.zeros(g, dtype=jnp.int32),
+    )
+    out = jax.lax.while_loop(cond, body, carry)
+    _, _, rounds, used, _, _, choices, choice_scores, lam, waits = out
+    return choices, choice_scores, used, rounds, lam, waits
+
+
+def oracle_cp_gang_place(
+    capacity: np.ndarray,
+    used0: np.ndarray,
+    asks: np.ndarray,
+    counts: np.ndarray,
+    eligible: np.ndarray,
+    scores: np.ndarray,
+    prio: np.ndarray,
+    job_counts: np.ndarray,
+    distinct: np.ndarray,
+    jobgrp: np.ndarray,
+    gang: np.ndarray,
+    w_rack: np.ndarray,
+    w_pod: np.ndarray,
+    rack_oh: np.ndarray,
+    pod_oh: np.ndarray,
+    lam0: np.ndarray,
+    steps: int,
+    max_c: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int, np.ndarray, np.ndarray]:
+    """Pure-NumPy host oracle for cp_gang_place_kernel — same round
+    math, stepwise, byte-identical outputs (uint32-view pinned)."""
+    g, n = scores.shape
+    arange_g = np.arange(g)
+    arange_n = np.arange(n)
+    same_gang = _cp_gang_same(gang)
+    q_rack = _cp_topo_quant(w_rack)
+    q_pod = _cp_topo_quant(w_pod)
+    used = used0.astype(np.float32).copy()
+    placed = np.zeros(g, dtype=np.int32)
+    assigned = np.zeros((g, n), dtype=np.int32)
+    choices = np.full((g, max_c), -1, dtype=np.int32)
+    choice_scores = np.zeros((g, max_c), dtype=np.float32)
+    lam = lam0.astype(np.float32).copy()
+    waits = np.zeros(g, dtype=np.int32)
+    counts = counts.astype(np.int32)
+    it = 0
+    rounds = 0
+    progress = True
+    while it < steps and progress:
+        sib_all, sib_other = _cp_siblings(jobgrp, assigned)
+        feas = _cp_feasible(
+            capacity, used, asks, eligible, job_counts, sib_all, distinct
+        )
+        active = placed < counts
+        mates_rack = _cp_topo_mates(same_gang, assigned, rack_oh)
+        mates_pod = _cp_topo_mates(same_gang, assigned, pod_oh)
+        topo = _cp_topo_term(q_rack, q_pod, mates_rack, mates_pod)
+        umask = np.where(
+            feas, _cp_gang_priced(scores, lam, sib_other, topo), _NEG_INF
+        )
+        claim, claimable, won, win, has, claims = _cp_winners(
+            umask, feas, active, prio, arange_g, arange_n
+        )
+        waits = waits + (claimable & ~won).astype(np.int32)
+        delta = np.where(has[:, None], asks[win], np.float32(0.0))
+        used = used + delta
+        slot = np.minimum(placed, max_c - 1)
+        old_c = choices[arange_g, slot]
+        old_s = choice_scores[arange_g, slot]
+        choices[arange_g, slot] = np.where(won, claim, old_c)
+        choice_scores[arange_g, slot] = np.where(
+            won, scores[arange_g, claim], old_s
+        )
+        onehot = won[:, None] & (claim[:, None] == arange_n[None, :])
+        assigned = assigned + onehot.astype(np.int32)
+        placed = placed + won.astype(np.int32)
+        lam = lam + ETA * np.maximum(claims - 1, 0).astype(np.float32)
+        lam = np.where(
+            claims == 0, np.maximum(lam - ETA, np.float32(0.0)), lam
+        )
+        progress = bool(claimable.any())
+        rounds += int(progress)
+        it += 1
+    return choices, choice_scores, used, rounds, lam, waits
+
+
+def release_incomplete_gangs(
+    choices: np.ndarray,
+    choice_scores: np.ndarray,
+    used: np.ndarray,
+    asks: np.ndarray,
+    counts: np.ndarray,
+    gang: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, list[int]]:
+    """Host post-pass over RAW kernel outputs (parity is pinned before
+    this runs): any gang with a member short of its count releases every
+    member's placements — capacity back to ``used``, choices to -1 —
+    so a partially-placed gang can never leave the solver layer.
+    Returns (choices, choice_scores, used, released_gang_ids)."""
+    choices = choices.copy()
+    choice_scores = choice_scores.copy()
+    used = used.copy()
+    released: list[int] = []
+    placed = (choices >= 0).sum(axis=1).astype(np.int32)
+    for gid in np.unique(gang[gang > 0]):
+        members = np.flatnonzero(gang == gid)
+        if bool(np.all(placed[members] >= counts[members])):
+            continue
+        released.append(int(gid))
+        for g in members:
+            for slot in range(choices.shape[1]):
+                node = int(choices[g, slot])
+                if node >= 0:
+                    used[node] -= asks[g]
+            choices[g, :] = -1
+            choice_scores[g, :] = np.float32(0.0)
+    return choices, choice_scores, used, released

@@ -93,6 +93,15 @@ class ClusterTensors:
     device_class_vocab: dict[str, int] = field(
         default_factory=lambda: {"": 0}
     )
+    # topology axis (gang scheduling): factored per-level coordinate id
+    # columns. Id 0 is always the coordinate-less "" so hand-built
+    # tensors and pre-topology snapshots behave identically; None =
+    # never flattened with topology (topology_columns synthesizes the
+    # all-zero columns).
+    topo_rack_ids: np.ndarray | None = None  # i32[N]
+    topo_pod_ids: np.ndarray | None = None  # i32[N]
+    topo_rack_vocab: dict[str, int] = field(default_factory=lambda: {"": 0})
+    topo_pod_vocab: dict[str, int] = field(default_factory=lambda: {"": 0})
     # row-ordered Node objects (nodes[i] ↔ row i); kept in sync by the
     # flattener / DeviceStateCache so host-side per-class constraint
     # evaluation never re-sorts the cluster
@@ -167,6 +176,23 @@ class ClusterTensors:
         """True when any node declares a non-empty device_class."""
         return len(self.device_class_vocab) > 1
 
+    def topology_columns(self) -> tuple[np.ndarray, np.ndarray]:
+        """Per-node (rack_ids, pod_ids) i32 columns (id 0 = no
+        coordinate). The factored per-level form of the topology
+        distance matrix: two rows are rack-adjacent iff their rack ids
+        match, pod-adjacent iff their pod ids match — N two-column
+        entries instead of an N×N hop matrix."""
+        if self.topo_rack_ids is None:
+            self.topo_rack_ids = np.zeros(self.padded_n, dtype=np.int32)
+        if self.topo_pod_ids is None:
+            self.topo_pod_ids = np.zeros(self.padded_n, dtype=np.int32)
+        return self.topo_rack_ids, self.topo_pod_ids
+
+    @property
+    def has_topology(self) -> bool:
+        """True when any node declares rack/pod coordinates."""
+        return len(self.topo_rack_vocab) > 1 or len(self.topo_pod_vocab) > 1
+
 
 def flatten_cluster(snap, nodes=None) -> ClusterTensors:
     """Build ClusterTensors from a StateSnapshot (or an explicit node list).
@@ -198,6 +224,10 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
     node_row: dict[str, int] = {}
     device_class_ids = np.zeros(pn, dtype=np.int32)
     device_class_vocab: dict[str, int] = {"": 0}
+    topo_rack_ids = np.zeros(pn, dtype=np.int32)
+    topo_pod_ids = np.zeros(pn, dtype=np.int32)
+    topo_rack_vocab: dict[str, int] = {"": 0}
+    topo_pod_vocab: dict[str, int] = {"": 0}
     region_ids = np.full(pn, -1, dtype=np.int32)
     region_vocab: dict[str, int] = {}
 
@@ -211,6 +241,13 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
         )
         device_class_ids[i] = device_class_vocab.setdefault(
             getattr(node, "device_class", ""), len(device_class_vocab)
+        )
+        topo = getattr(node, "topology", None) or {}
+        topo_rack_ids[i] = topo_rack_vocab.setdefault(
+            topo.get("rack", ""), len(topo_rack_vocab)
+        )
+        topo_pod_ids[i] = topo_pod_vocab.setdefault(
+            topo.get("pod", ""), len(topo_pod_vocab)
         )
         if not node.computed_class:
             node.compute_class()
@@ -239,6 +276,10 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
         nodes=list(nodes),
         device_class_ids=device_class_ids,
         device_class_vocab=device_class_vocab,
+        topo_rack_ids=topo_rack_ids,
+        topo_pod_ids=topo_pod_ids,
+        topo_rack_vocab=topo_rack_vocab,
+        topo_pod_vocab=topo_pod_vocab,
         region_ids=region_ids,
         region_vocab=region_vocab,
     )
@@ -357,6 +398,15 @@ class GroupAsk:
     # pass resolves contested nodes by tier before score (scheduler/
     # cp.py); the per-group kernels never read it.
     priority: int = 50
+    # Gang scheduling (structs/job.py gang stanza): True when this group
+    # is a member of its job's all-or-nothing gang. The signed topology
+    # weights price co-location (+, colocate) or anti-location (−,
+    # spread) against gang-mate assignments at each level; 0.0 = no term
+    # at that level. Only the cp-gang dispatcher reads any of these —
+    # the base kernels stay bit-identical.
+    gang_member: bool = False
+    gang_weight_rack: float = 0.0
+    gang_weight_pod: float = 0.0
 
     @property
     def has_spreads(self) -> bool:
@@ -845,6 +895,7 @@ def flatten_group_ask(
         c.operand == "distinct_hosts" for c in job.constraints_for_group(tg)
     )
     throughputs, has_tp = job_throughput_vector(ct, job)
+    gang_member, gw_rack, gw_pod = gang_terms(job, tg.name)
 
     return GroupAsk(
         job_id=job.id,
@@ -865,4 +916,26 @@ def flatten_group_ask(
         has_throughputs=has_tp,
         profile=job_profile_key(job),
         priority=job.priority,
+        gang_member=gang_member,
+        gang_weight_rack=gw_rack,
+        gang_weight_pod=gw_pod,
     )
+
+
+def gang_terms(job, tg_name: str) -> tuple[bool, float, float]:
+    """Resolve one group's gang membership + signed per-level topology
+    weights from the job's gang stanza. Non-members (and gang-less jobs)
+    get (False, 0.0, 0.0) — the zero that keeps every pre-gang path
+    untouched."""
+    gang = getattr(job, "gang", None) or {}
+    groups = gang.get("groups") or []
+    if tg_name not in groups:
+        return False, 0.0, 0.0
+    weights = {"rack": 0.0, "pod": 0.0}
+    colocate = gang.get("colocate") or {}
+    if colocate.get("level") in weights:
+        weights[colocate["level"]] = float(colocate.get("weight", 1.0))
+    spread = gang.get("spread") or {}
+    if spread.get("level") in weights:
+        weights[spread["level"]] = -float(spread.get("weight", 1.0))
+    return True, weights["rack"], weights["pod"]

@@ -92,6 +92,12 @@ CHUNK = 16
 
 
 def _pow10(x):
+    # KNOWN 1-ulp portability leak: XLA's exp expansion is not
+    # bit-stable across shardings (fmuladd/vector-width decisions shift
+    # with the per-shard loop bounds), so scores built under an active
+    # mesh can differ from degenerate ones in the last bit. Solver
+    # kernels stay byte-portable on FIXED inputs (tests pin that); the
+    # scoring stack's cross-mesh stability is input-dependent.
     return jnp.exp(_LN10 * x)
 
 

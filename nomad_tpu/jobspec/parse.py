@@ -482,6 +482,37 @@ def _parse_throughputs(b: hcl.Body, ctx: hcl.EvalContext, job_id: str) -> dict:
     return {k: float(v) for k, v in raw.items()}
 
 
+def _parse_gang(b: hcl.Body, ctx: hcl.EvalContext, job_id: str) -> dict:
+    """``gang {}`` block: all-or-nothing member groups plus optional
+    colocate/spread topology terms. Group-name references are checked
+    against the job's real groups later (validate_job), after groups
+    have parsed; the structural checks reject here with exact
+    messages."""
+    from ..structs.job import validate_gang
+
+    gb = b.first("gang")
+    if gb is None:
+        return {}
+    ga = _attrs(gb.body, ctx)
+    gang: dict[str, Any] = {}
+    if "groups" in ga:
+        gang["groups"] = list(ga["groups"]) if isinstance(
+            ga["groups"], (list, tuple)
+        ) else ga["groups"]
+    for stanza in ("colocate", "spread"):
+        tb = gb.body.first(stanza)
+        if tb is not None:
+            gang[stanza] = _attrs(tb.body, ctx)
+        elif stanza in ga:
+            gang[stanza] = ga[stanza]
+    problems = validate_gang(gang)
+    if problems:
+        raise JobspecError(
+            f"job {job_id!r}: invalid gang stanza:\n  " + "\n  ".join(problems)
+        )
+    return gang
+
+
 def parse_job(block: hcl.Block, ctx: hcl.EvalContext) -> Job:
     if not block.labels:
         raise JobspecError("job block requires an id label")
@@ -518,6 +549,7 @@ def parse_job(block: hcl.Block, ctx: hcl.EvalContext) -> Job:
     _collect_cas(b, ctx, job.constraints, job.affinities, job.spreads)
     job.meta = _meta(b, ctx)
     job.throughputs = _parse_throughputs(b, ctx, job.id)
+    job.gang = _parse_gang(b, ctx, job.id)
     # job-level update{} is the default for all groups (jobspec semantics)
     job_update: Optional[UpdateStrategy] = None
     ub = b.first("update")

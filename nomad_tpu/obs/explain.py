@@ -101,6 +101,10 @@ class PlacementExplanation:
     # every other algorithm — the JSON shape only grows a "cp" block
     # when the solver ran, so existing schema pins are untouched.
     cp: dict | None = None
+    # gang provenance when the cp-gang pass scored a gang member
+    # (scheduler/cp.py): {"gang_id", "members", "topology_score",
+    # "release_rounds"}. None otherwise — same only-grows contract.
+    gang: dict | None = None
 
 
 def _feasibility(capacity, used, a, n: int, throughputs=None):
@@ -474,6 +478,30 @@ def explain_cp_group(
     return ex
 
 
+def explain_cp_gang(
+    cluster,
+    a,
+    used0,
+    *,
+    scores_row,
+    cp: dict | None = None,
+    gang_info: dict | None = None,
+    top_k: int = DEFAULT_TOP_K,
+) -> PlacementExplanation:
+    """Explanation for one group of the cp-gang joint pass: the
+    cp-pack explanation plus gang provenance — which gang the group
+    belongs to, its member set, the signed topology score its final
+    placement achieved, and how many auction rounds the all-or-nothing
+    gate held its wins back (release_rounds)."""
+    ex = explain_cp_group(
+        cluster, a, used0, scores_row=scores_row, cp=cp, top_k=top_k
+    )
+    ex.algorithm = "cp-gang"
+    if gang_info is not None:
+        ex.gang = dict(gang_info)
+    return ex
+
+
 def _instance_components_vec(capacity, used0, a, rows, mine, algorithm_spread):
     """Vectorized per-instance breakdowns for one lane's committed rows —
     the blocks-free fast path of the finalize replay. Instance i on row
@@ -693,4 +721,5 @@ def explanation_to_dict(ex: PlacementExplanation) -> dict:
         "rejections": dict(ex.rejections),
         "placed_nodes": list(ex.placed_nodes),
         **({"cp": dict(ex.cp)} if ex.cp is not None else {}),
+        **({"gang": dict(ex.gang)} if ex.gang is not None else {}),
     }

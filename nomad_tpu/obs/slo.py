@@ -89,6 +89,15 @@ SLO_SCHEMA = tuple(sorted(
         "device_cache.pipeline_overlap_ms",
     ]
     + [
+        "gang.atomic_releases",
+        "gang.released_allocs",
+        "gang.stopped_allocs",
+        "gang.groups_in",
+        "gang.commits",
+        "gang.kernel_releases",
+        "gang.fallback_failures",
+    ]
+    + [
         "ring_coverage.traces_recorded",
         "ring_coverage.traces_evicted",
         "ring_coverage.coverage",
@@ -403,6 +412,20 @@ class SloCollector:
                 "completion_rate_per_s": round(completions / span, 3),
             },
             "counters": ctr,
+            # gang scheduling health: the atomic-commit seam (scheduler/
+            # generic.py, law 15) plus the cp-gang kernel's own ledger —
+            # windowed deltas like every other counter in the report
+            "gang": {
+                "atomic_releases": _delta("nomad.gang.releases"),
+                "released_allocs": _delta("nomad.gang.released_allocs"),
+                "stopped_allocs": _delta("nomad.gang.stopped_allocs"),
+                "groups_in": _delta("nomad.cp.gang_groups_in"),
+                "commits": _delta("nomad.cp.gang_commits"),
+                "kernel_releases": _delta("nomad.cp.gang_releases"),
+                "fallback_failures": _delta(
+                    "nomad.cp.gang_fallback_failures"
+                ),
+            },
             "calibration": self._calibration_block(),
             "device_cache": self._device_cache_block(),
             "ring_coverage": {

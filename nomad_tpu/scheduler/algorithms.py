@@ -155,6 +155,20 @@ class CpPackAlgorithm(SchedulerAlgorithm):
         return CpPlacementKernel(force_scan, mesh=mesh)
 
 
+@register_algorithm
+class CpGangAlgorithm(SchedulerAlgorithm):
+    name = "cp-gang"
+    description = (
+        "cp-pack plus all-or-nothing gangs: topology-priced co/anti-"
+        "location with atomic release of incomplete gangs"
+    )
+
+    def make_kernel(self, force_scan: bool = False, mesh=None):
+        from .cp import CpGangPlacementKernel
+
+        return CpGangPlacementKernel(force_scan, mesh=mesh)
+
+
 # -- registry-routed score matrix -------------------------------------------
 
 

@@ -134,6 +134,31 @@ def plan_job(store, job) -> dict:
                 "stop": stopped.get(tg.name, 0),
                 "preemptions": preempted,
             }
+    # gang feasibility verdict: a gang job either commits every member
+    # or releases them all (scheduler/generic.py _enforce_gang_atomicity,
+    # law 15) — so the dry run can state the all-or-nothing outcome
+    # directly instead of making the operator infer it from per-group
+    # failure rows
+    gang_verdict = None
+    gang = getattr(candidate, "gang", None) or {}
+    members = list(gang.get("groups") or ())
+    if members:
+        reasons = sorted({
+            r
+            for m in members
+            for r in (failed.get(m, {}).get("rejections") or {})
+            if r.startswith("gang-")
+        })
+        commits = not any(m in failed for m in members)
+        gang_verdict = {
+            "members": {
+                m: {"place": annotations.get(m, {}).get("place", 0)}
+                for m in sorted(members)
+            },
+            "feasible": commits,
+            "released": bool(reasons) or not commits,
+            "reasons": reasons,
+        }
     return {
         "job_id": candidate.id,
         "version": candidate.version,
@@ -141,4 +166,5 @@ def plan_job(store, job) -> dict:
         "annotations": annotations,
         "failed_tg_allocs": failed,
         "placement_explanations": explanations,
+        **({"gang": gang_verdict} if gang_verdict is not None else {}),
     }

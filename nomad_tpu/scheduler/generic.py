@@ -633,6 +633,82 @@ class GenericScheduler:
                         )
                         alloc.reschedule_tracker = RescheduleTracker(events=events)
                 self.plan.append_alloc(alloc)
+        self._enforce_gang_atomicity(ct)
+
+    GANG_RELEASE_DESC = "alloc released: gang member group failed placement"
+
+    def _enforce_gang_atomicity(self, ct) -> None:
+        """All-or-nothing commit for the job's gang stanza (invariant
+        law 15): if any member group failed placement this pass — or the
+        ``gang.commit_drop`` chaos site drops the commit mid-gang — the
+        whole gang releases: this plan's member placements come back
+        out, surviving member allocs from prior evals are stopped, and
+        EVERY member lands in ``failed_tg_allocs`` with per-group
+        rejection detail, so the gang rides one blocked eval instead of
+        striping a partial plan. Algorithm-independent on purpose: the
+        cp-gang kernel already releases within a pass, and this seam
+        holds the invariant across passes, fallbacks, and partial plan
+        commits (a partially-committed gang from an optimistic plan is
+        clawed back by the stop path on the retry eval)."""
+        job = self.job
+        gang = getattr(job, "gang", None) if job is not None else None
+        members = set((gang or {}).get("groups") or ())
+        if not members or job.stopped():
+            return
+        from ..chaos.plane import chaos_site
+
+        failed = members & set(self.failed_tg_allocs)
+        reason = "gang-infeasible"
+        if not failed:
+            # a kill here is the mid-gang-commit thread death the
+            # worker's recovery contract must absorb (plan unsubmitted
+            # → nothing committed → trivially atomic)
+            if chaos_site("gang.commit_drop") == "drop":
+                reason = "gang-commit-drop"
+            else:
+                return
+        from ..utils.metrics import global_metrics
+
+        released = 0
+        for node_id in list(self.plan.node_allocation):
+            allocs = self.plan.node_allocation[node_id]
+            kept = [
+                a for a in allocs
+                if a.job_id != job.id or a.task_group not in members
+            ]
+            released += len(allocs) - len(kept)
+            if kept:
+                self.plan.node_allocation[node_id] = kept
+            else:
+                del self.plan.node_allocation[node_id]
+        already = {
+            a.id for ups in self.plan.node_update.values() for a in ups
+        }
+        stopped = 0
+        if self.snapshot is not None:
+            for a in self.snapshot.allocs_by_job(job.namespace, job.id):
+                if (
+                    a.terminal_status()
+                    or a.desired_status != ALLOC_DESIRED_RUN
+                    or a.task_group not in members
+                    or a.id in already
+                ):
+                    continue
+                self.plan.append_stopped_alloc(a, self.GANG_RELEASE_DESC)
+                stopped += 1
+        for tg_name in sorted(members):
+            metric = self.failed_tg_allocs.get(tg_name)
+            if metric is None:
+                metric = AllocMetric(
+                    nodes_evaluated=ct.num_nodes if ct is not None else 0
+                )
+                self.failed_tg_allocs[tg_name] = metric
+            metric.rejections[reason] = metric.rejections.get(reason, 0) + 1
+        global_metrics.incr("nomad.gang.releases")
+        if released:
+            global_metrics.incr("nomad.gang.released_allocs", released)
+        if stopped:
+            global_metrics.incr("nomad.gang.stopped_allocs", stopped)
 
     def _assign_devices(self, tg, node_id):
         from .device import assign_devices_for_plan

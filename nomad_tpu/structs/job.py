@@ -311,6 +311,12 @@ class Job:
     # every class identically. Values must be finite and >= 0 (0 = the
     # job cannot make progress on that class).
     throughputs: dict[str, float] = field(default_factory=dict)
+    # gang scheduling stanza: {"groups": [group names placed all-or-
+    # nothing], "colocate": {"level": "rack"|"pod", "weight": > 0},
+    # "spread": {...}}. colocate/spread are optional topology terms; an
+    # empty dict means no gang and the job schedules exactly as before
+    # this field existed. Validated by validate_gang.
+    gang: dict = field(default_factory=dict)
     meta: dict[str, str] = field(default_factory=dict)
     status: str = JOB_STATUS_PENDING
     stop: bool = False
@@ -406,6 +412,75 @@ def validate_throughputs(throughputs: dict) -> list[str]:
     return problems
 
 
+GANG_TOPOLOGY_LEVELS = ("rack", "pod")
+
+
+def validate_gang(gang: dict, group_names=None) -> list[str]:
+    """Validate a gang stanza, returning structured problem strings
+    (empty = valid). Shared by jobspec parse and job admission.
+    ``group_names`` (when given) checks member references against the
+    job's real task groups."""
+    problems: list[str] = []
+    if not isinstance(gang, dict):
+        return [f"gang must be a mapping, got {type(gang).__name__}"]
+    if not gang:
+        return problems
+    unknown = set(gang) - {"groups", "colocate", "spread"}
+    for key in sorted(unknown):
+        problems.append(f"gang has unknown key {key!r}")
+    groups = gang.get("groups")
+    if not isinstance(groups, list) or not groups:
+        problems.append("gang.groups must be a non-empty list of group names")
+        groups = []
+    seen = set()
+    for name in groups:
+        if not isinstance(name, str) or not name:
+            problems.append(
+                f"gang.groups entries must be non-empty strings, got {name!r}"
+            )
+            continue
+        if name in seen:
+            problems.append(f"gang.groups lists {name!r} twice")
+        seen.add(name)
+        if group_names is not None and name not in group_names:
+            problems.append(f"gang.groups references unknown group {name!r}")
+    levels_used = {}
+    for stanza in ("colocate", "spread"):
+        term = gang.get(stanza)
+        if term is None:
+            continue
+        if not isinstance(term, dict):
+            problems.append(
+                f"gang.{stanza} must be a mapping, got {type(term).__name__}"
+            )
+            continue
+        level = term.get("level")
+        if level not in GANG_TOPOLOGY_LEVELS:
+            problems.append(
+                f"gang.{stanza}.level must be one of "
+                f"{'/'.join(GANG_TOPOLOGY_LEVELS)}, got {level!r}"
+            )
+        elif level in levels_used:
+            problems.append(
+                f"gang.colocate and gang.spread both target level {level!r}"
+            )
+        else:
+            levels_used[level] = stanza
+        weight = term.get("weight", 1.0)
+        if isinstance(weight, bool) or not isinstance(weight, (int, float)):
+            problems.append(
+                f"gang.{stanza}.weight must be a number, "
+                f"got {type(weight).__name__}"
+            )
+        else:
+            w = float(weight)
+            if w != w or w in (float("inf"), float("-inf")):
+                problems.append(f"gang.{stanza}.weight must be finite, got {w}")
+            elif w <= 0:
+                problems.append(f"gang.{stanza}.weight must be > 0, got {w}")
+    return problems
+
+
 def validate_job(job: Job) -> None:
     """Admission validation — the high-value subset of structs.Job.Validate
     + jobspec semantic checks (nomad/structs/structs.go Job.Validate,
@@ -427,6 +502,9 @@ def validate_job(job: Job) -> None:
     if not job.task_groups:
         raise JobValidationError("job must have at least one task group")
     for problem in validate_throughputs(job.throughputs):
+        raise JobValidationError(problem)
+    group_names = {tg.name for tg in job.task_groups}
+    for problem in validate_gang(job.gang, group_names):
         raise JobValidationError(problem)
     seen_groups = set()
     for tg in job.task_groups:

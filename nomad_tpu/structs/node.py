@@ -44,6 +44,11 @@ class Node:
     # the node participates in scheduling exactly as before this field
     # existed (throughput coefficient 1.0 for every job).
     device_class: str = ""
+    # physical placement coordinates for gang/topology-aware scheduling:
+    # level → id, e.g. {"rack": "r03", "pod": "p1", "ici": "2.1"}. Empty
+    # means topology-less — the node participates in scheduling exactly
+    # as before this field existed (every topology term contributes 0).
+    topology: dict[str, str] = field(default_factory=dict)
     attributes: dict[str, str] = field(default_factory=dict)
     meta: dict[str, str] = field(default_factory=dict)
     links: dict[str, str] = field(default_factory=dict)
@@ -106,6 +111,13 @@ class Node:
         # it) silently treats a v5e and a CPU box as interchangeable.
         h.update(b"dev:")
         h.update(self.device_class.encode())
+        # topology participates for the same reason: a rack/pod flip must
+        # flip the computed class so the device cache (keyed on the class
+        # hash) rebuilds its topology id columns.
+        h.update(b"topo:")
+        for k in sorted(self.topology):
+            h.update(k.encode())
+            h.update(str(self.topology[k]).encode())
         self.computed_class = "v2:" + h.hexdigest()
 
     def lookup_attribute(self, target: str) -> Optional[str]:
@@ -127,6 +139,8 @@ class Node:
             return self.node_class
         if t == "node.device_class":
             return self.device_class
+        if t.startswith("node.topology."):
+            return self.topology.get(t[len("node.topology."):])
         if t.startswith("attr."):
             return self.attributes.get(t[len("attr."):])
         if t.startswith("meta."):

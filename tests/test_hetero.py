@@ -393,6 +393,7 @@ class TestRegistrySelection:
     def test_builtins_registered(self):
         assert algorithms.available() == [
             "binpack",
+            "cp-gang",
             "cp-pack",
             "hetero-cost",
             "hetero-makespan",

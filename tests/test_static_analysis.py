@@ -34,6 +34,7 @@ from nomad_tpu.analysis.rules.scorestate import ScoreStateDiscipline
 from nomad_tpu.analysis.rules.shardingseam import ShardingSeamDiscipline
 from nomad_tpu.analysis.rules.solverseam import SolverSeamDiscipline
 from nomad_tpu.analysis.rules.spans import SpanCoverage
+from nomad_tpu.analysis.rules.topologyseam import TopologySeamDiscipline
 from nomad_tpu.analysis.rules.swallow import SilentExceptionSwallow
 from nomad_tpu.analysis.rules.wallclock import BareWallClockInBrokerServer
 from nomad_tpu.utils import backend
@@ -871,6 +872,71 @@ class TestNTA016:
             ), rel
 
 
+# -- NTA020: topology/gang pricing routed only through the cp-gang seam ----
+
+
+class TestNTA020:
+    BAD = (
+        "from ..device.cp import cp_gang_place_kernel, topo_onehot\n"
+        "def fast_gang(batch, ct):\n"
+        "    oh = topo_onehot(ct.topo_rack_ids, 8)\n"
+        "    return cp_gang_place_kernel(batch.capacity, oh)\n"
+    )
+
+    def test_direct_gang_kernel_call_in_scheduler_triggers(self):
+        fs = run(self.BAD, "nomad_tpu/scheduler/shortcut.py",
+                 TopologySeamDiscipline)
+        assert rule_ids(fs) == ["NTA020", "NTA020"]
+        assert fs[0].symbol == "fast_gang"
+
+    def test_adhoc_topology_columns_in_server_triggers(self):
+        src = (
+            "def same_rack(ct, i, j):\n"
+            "    rack, _pod = ct.topology_columns()\n"
+            "    return rack[i] == rack[j]\n"
+        )
+        fs = run(src, "nomad_tpu/server/affinity.py",
+                 TopologySeamDiscipline)
+        assert rule_ids(fs) == ["NTA020"]
+
+    def test_registry_routed_dispatch_is_clean(self):
+        src = (
+            "from .algorithms import make_kernel\n"
+            "def place(cfg, ct, asks):\n"
+            "    return make_kernel('cp-gang').place(ct, asks)\n"
+        )
+        assert run(src, "nomad_tpu/scheduler/custom.py",
+                   TopologySeamDiscipline) == []
+
+    def test_registry_and_cp_seam_are_exempt(self):
+        for rel in (
+            "nomad_tpu/scheduler/algorithms.py",
+            "nomad_tpu/scheduler/cp.py",
+        ):
+            assert run(self.BAD, rel, TopologySeamDiscipline) == []
+
+    def test_device_package_is_out_of_scope(self):
+        # parity pinning calls the gang kernel and oracle directly
+        assert run(self.BAD, "nomad_tpu/device/parity.py",
+                   TopologySeamDiscipline) == []
+
+    def test_scheduler_and_server_at_head_are_clean(self):
+        """Zero ad-hoc topology consumers to ratchet: every caller goes
+        through the cp-gang plugin."""
+        for rel in (
+            ("nomad_tpu", "scheduler", "generic.py"),
+            ("nomad_tpu", "scheduler", "system.py"),
+            ("nomad_tpu", "server", "server.py"),
+            ("nomad_tpu", "server", "worker.py"),
+        ):
+            path = os.path.join(REPO_ROOT, *rel)
+            with open(path) as f:
+                src = f.read()
+            assert (
+                run(src, "/".join(rel), TopologySeamDiscipline) == []
+            ), rel
+
+
 class TestNTA017:
     def test_bare_jit_call_triggers(self):
         src = (
@@ -1083,7 +1149,7 @@ class TestBaselineRatchet:
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
             "NTA007", "NTA008", "NTA009", "NTA010", "NTA011", "NTA012",
             "NTA013", "NTA014", "NTA015", "NTA016", "NTA017", "NTA018",
-            "NTA019",
+            "NTA019", "NTA020",
         ]
 
 
