@@ -259,15 +259,43 @@ def run_cp_gang() -> None:
     gang = np.ones(g, dtype=np.int32)  # both groups in gang 1
     w_rack = np.full(g, 1.0, dtype=np.float32)
     w_pod = np.zeros(g, dtype=np.float32)
+    w_ici = np.full(g, 0.5, dtype=np.float32)
     rack_oh = np.zeros((n, levels), dtype=np.int32)
     rack_oh[np.arange(n), 1 + np.arange(n) % (levels - 1)] = 1
     pod_oh = np.zeros((n, 2), dtype=np.int32)
     pod_oh[:, 1] = 1
+    ici_oh = np.zeros((n, levels * 2), dtype=np.int32)
+    ici_oh[np.arange(n), 1 + np.arange(n) % (levels * 2 - 1)] = 1
     lam0 = np.zeros(n, dtype=np.float32)
     cp_gang_place_kernel(
         capacity, used0, asks, counts, eligible, scores, prio,
-        job_counts, distinct, jobgrp, gang, w_rack, w_pod,
-        rack_oh, pod_oh, lam0, steps=8, max_c=4,
+        job_counts, distinct, jobgrp, gang, w_rack, w_pod, w_ici,
+        rack_oh, pod_oh, ici_oh, lam0, steps=8, max_c=4,
+    )
+
+
+def run_migrate() -> None:
+    """migrate_plan_kernel: the defrag plane's bounded-budget move
+    selection over a small fragmented fleet."""
+    import numpy as np
+
+    from ...device.migrate import migrate_plan_kernel
+
+    a, n = 4, N_NODES
+    capacity = np.full((n, D), 16000.0, dtype=np.float32)
+    used0 = capacity * 0.2
+    sizes = np.full((a, D), 500.0, dtype=np.float32)
+    cur = (np.arange(a) % n).astype(np.int32)
+    eligible = np.ones((a, n), dtype=bool)
+    scores = np.linspace(
+        0.1, 0.9, a * n, dtype=np.float32
+    ).reshape(a, n)
+    cur_scores = scores[np.arange(a), cur]
+    move_cost = np.full(a, 0.05, dtype=np.float32)
+    lam0 = np.zeros(n, dtype=np.float32)
+    migrate_plan_kernel(
+        capacity, used0, sizes, cur, eligible, scores, cur_scores,
+        move_cost, np.int32(2), lam0, steps=8,
     )
 
 
@@ -284,4 +312,5 @@ def exercise_fleet(explain: bool = False) -> dict:
     run_hetero()
     run_cp()
     run_cp_gang()
+    run_migrate()
     return backend.kernel_registry()

@@ -22,6 +22,7 @@ from .kernelseam import KernelSeamDiscipline  # noqa: E402
 from .provenance import ConstantProvenanceDiscipline  # noqa: E402
 from .scorestate import ScoreStateDiscipline  # noqa: E402
 from .topologyseam import TopologySeamDiscipline  # noqa: E402
+from .migrationseam import MigrationSeamDiscipline  # noqa: E402
 
 REGISTRY = [
     WallClockInScoringPath,  # NTA001
@@ -44,6 +45,7 @@ REGISTRY = [
     ConstantProvenanceDiscipline,  # NTA018
     ScoreStateDiscipline,  # NTA019
     TopologySeamDiscipline,  # NTA020
+    MigrationSeamDiscipline,  # NTA021
 ]
 
 __all__ = ["REGISTRY"]

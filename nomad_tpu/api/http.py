@@ -164,6 +164,12 @@ class HTTPAgent:
                 self.handle_raft_peer,
             ),
             (
+                # continuous-defrag control plane (server/defrag.py):
+                # GET status/counters, POST an immediate cycle
+                re.compile(r"^/v1/operator/defrag$"),
+                self.handle_operator_defrag,
+            ),
+            (
                 re.compile(r"^/v1/job/(?P<job_id>[^/]+)/dispatch$"),
                 self.handle_job_dispatch,
             ),
@@ -1243,6 +1249,30 @@ class HTTPAgent:
             )
         return {"removed": pid}
 
+    def handle_operator_defrag(self, method, body, query):
+        """/v1/operator/defrag — the live-migration control plane.
+
+        GET returns the controller's status block (enabled/paused,
+        interval, budget, packing-efficiency gauge, move counters).
+        POST triggers an immediate defrag cycle regardless of the
+        periodic interval; ``{"paused": true|false}`` in the body flips
+        the pause latch instead (a paused controller plans nothing but
+        keeps serving recovery via trigger)."""
+        defrag = self.server.defrag
+        if method == "GET":
+            self._enforce(query, "operator_read")
+            return defrag.status()
+        if method not in ("POST", "PUT"):
+            raise APIError(405, f"method {method} not allowed")
+        self._enforce(query, "operator_write")
+        if isinstance(body, dict) and "paused" in body:
+            defrag.paused = bool(body["paused"])
+            return defrag.status()
+        defrag.trigger()
+        out = defrag.status()
+        out["triggered"] = True
+        return out
+
     def handle_job_dispatch(self, method, body, query, job_id):
         if method not in ("POST", "PUT"):
             raise APIError(405, "POST required")
@@ -1702,6 +1732,10 @@ class HTTPAgent:
             # (scheduler/cp.py nomad.cp.gang_*) plus the law-15 atomic
             # release seam (scheduler/generic.py nomad.gang.*)
             "gang": self._gang_counters(),
+            # migration-plane ledger (server/defrag.py, law 16): the
+            # two-phase move counters plus the drainer's graceful-vs-
+            # forced exit split
+            "migrate": self._migrate_counters(),
         }
 
     @staticmethod
@@ -1714,6 +1748,21 @@ class HTTPAgent:
             for k, v in sorted(counters.items())
             if k.startswith(("nomad.gang.", "nomad.cp.gang_"))
         }
+
+    @staticmethod
+    def _migrate_counters() -> dict:
+        from ..utils.metrics import global_metrics
+
+        snap = global_metrics.snapshot()
+        out = {
+            k: v
+            for k, v in sorted(snap["counters"].items())
+            if k.startswith(("nomad.migrate.", "nomad.drain."))
+        }
+        gauge = snap["gauges"].get("nomad.migrate.packing_efficiency")
+        if gauge is not None:
+            out["nomad.migrate.packing_efficiency"] = round(gauge, 6)
+        return out
 
     def handle_agent_resilience(self, method, body, query):
         """/v1/agent/resilience — per-kernel circuit-breaker snapshots,

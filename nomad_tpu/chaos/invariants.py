@@ -83,6 +83,16 @@ true no matter which faults fired:
     partial gang. Holds through ``gang.commit_drop`` dropped/killed
     commits and cp-gang in-pass releases (scheduler/generic.py
     ``_enforce_gang_atomicity``, invariant law 15).
+``migration_conservation``
+    live migration conserves identity and capacity (server/defrag.py).
+    After quiesce every migrated alloc serves exactly once: no group
+    slot holds two live defrag replacements (a double-committed move),
+    and no replacement's source alloc is still live (an unrecovered
+    half-move — the recovery scan bounds mid-move to one cycle). The
+    controller's mid-move capacity audit never fired
+    (``nomad.migrate.capacity_violations`` stays 0): free capacity was
+    conserved at every point between phase A and phase B, including
+    through ``migrate.move_drop`` and ``migrate.kill_mid_move`` faults.
 """
 
 from __future__ import annotations
@@ -112,6 +122,7 @@ INVARIANTS = (
     "cp_assignment_conservation",
     "calibration_sanity",
     "gang_atomicity",
+    "migration_conservation",
 )
 
 
@@ -626,6 +637,55 @@ def check_cluster(
             )
     report.info["gang_jobs"] = gang_jobs
 
+    # -- migration_conservation --------------------------------------------
+    # Law 16: every migrated alloc serves exactly once after quiesce.
+    # The two-phase protocol (server/defrag.py) may hold both halves of
+    # a move live BETWEEN phases, but quiesce includes the recovery
+    # scan, so a surviving pair means phase B was lost AND never
+    # recovered; two live replacements for one slot means one planned
+    # move committed twice. The controller's own mid-move audits
+    # (capacity with both halves counted) must never have fired.
+    from ..server.defrag import DEFRAG_DESC
+
+    counters_now = global_metrics.snapshot()["counters"]
+    migrate_active = any(
+        k.startswith("nomad.migrate.") for k in counters_now
+    )
+    reps_by_slot: dict[tuple, int] = {}
+    for a in snap.allocs():
+        if a.terminal_status() or a.desired_description != DEFRAG_DESC:
+            continue
+        migrate_active = True
+        report.checked.setdefault("migration_conservation", True)
+        slot = (a.namespace, a.job_id, a.task_group, a.name)
+        reps_by_slot[slot] = reps_by_slot.get(slot, 0) + 1
+        if reps_by_slot[slot] > 1:
+            report._fail(
+                "migration_conservation",
+                "/".join(slot),
+                f"{reps_by_slot[slot]} live defrag replacements for one "
+                "group slot (a move double-committed)",
+            )
+        if a.previous_allocation:
+            old = snap.alloc_by_id(a.previous_allocation)
+            if old is not None and not old.terminal_status():
+                report._fail(
+                    "migration_conservation",
+                    a.id,
+                    f"half-move unresolved at quiesce: source alloc "
+                    f"{old.id} still live beside its replacement",
+                )
+    if migrate_active:
+        report.checked.setdefault("migration_conservation", True)
+        cap_viol = counters_now.get("nomad.migrate.capacity_violations", 0)
+        if cap_viol:
+            report._fail(
+                "migration_conservation",
+                "capacity",
+                f"mid-move capacity audit fired {cap_viol} times "
+                "(free capacity went negative between phases)",
+            )
+
     # context for the human-facing dump
     from ..resilience.breaker import snapshot_all
 
@@ -638,6 +698,7 @@ def check_cluster(
             "nomad.chaos.", "nomad.resilience.", "nomad.lane.",
             "nomad.overlay.", "nomad.plan.lane", "nomad.plan.cross_lane",
             "nomad.admission.", "nomad.cp.", "nomad.gang.",
+            "nomad.migrate.", "nomad.drain.",
         ))
         or k == "nomad.broker.nack_redelivery_delayed"
         or k.endswith(".swallowed_errors")

@@ -104,6 +104,14 @@ SITES: dict[str, tuple[str, ...]] = {
     # leaves the plan unsubmitted (trivially atomic). Invariant law 15:
     # after quiesce a gang job is fully placed or fully absent.
     "gang.commit_drop": ("drop", "kill"),
+    # defrag two-phase moves (server/defrag.py): a dropped move commits
+    # nothing (conservation trivial); a kill or drop BETWEEN phase A
+    # (replacement placed) and phase B (old stopped) leaves a committed
+    # half-move that the recovery scan must finish, never double.
+    # Invariant law 16: after quiesce every migrating alloc serves
+    # exactly once, and capacity was conserved at every mid-move point.
+    "migrate.move_drop": ("drop",),
+    "migrate.kill_mid_move": ("kill", "drop"),
 }
 
 FAULT_KINDS = (
@@ -142,6 +150,9 @@ _HORIZON = {
     "gang.commit_drop": (0.125, 2),
     # hit once per estimator input sample (span fan-out rate)
     "calib.telemetry_drop": (1.0, 8),
+    # hit once per planned defrag move, a few moves per cycle
+    "migrate.move_drop": (0.125, 2),
+    "migrate.kill_mid_move": (0.125, 2),
 }
 
 

@@ -136,7 +136,11 @@ def _build_job(seq: int, count: int, priority: int):
                 Task(
                     name=name,
                     driver="exec",
-                    resources=Resources(cpu=256, memory_mb=128),
+                    # sized so the seeded workload fills well under the
+                    # fleet: deregister churn leaves holes AND headroom,
+                    # which is what live migration needs to act on — a
+                    # saturated fleet has no destination for any move
+                    resources=Resources(cpu=128, memory_mb=64),
                 )
             ],
         )
@@ -156,6 +160,27 @@ def _build_job(seq: int, count: int, priority: int):
     else:
         j.task_groups = [_tg("web")]
     return j
+
+
+def _flip_pending(server) -> None:
+    """The run's stand-in for a client plane: pending allocs come up
+    ``running`` through the ordinary client-update path. Without it the
+    fleet never serves — drainer health checks and the defrag candidate
+    filter (server/defrag.py: only running allocs migrate) would see
+    nothing to act on. Failures are a client's problem — it retries."""
+    import copy
+
+    updates = []
+    for a in server.store.allocs():
+        if a.desired_status == "run" and a.client_status == "pending":
+            u = copy.copy(a)
+            u.client_status = "running"
+            updates.append(u)
+    if updates:
+        try:
+            server.update_allocs_from_client(updates)
+        except Exception:
+            pass  # injected raft drop: a real client retries next poll
 
 
 def _drive_workload(server, seed: int, steps: int) -> dict:
@@ -215,8 +240,11 @@ def _drive_workload(server, seed: int, steps: int) -> dict:
             counts["deregisters"] += 1
         if _step % 16 == 15:
             # let the pipeline interleave with the op stream so faults
-            # land mid-flight, not only against a drained cluster
+            # land mid-flight, not only against a drained cluster —
+            # and bring placed allocs up so migration has live targets
+            _flip_pending(server)
             time.sleep(0.01)
+    _flip_pending(server)
     return counts
 
 
@@ -233,7 +261,13 @@ def _quiesce(server, timeout: float) -> bool:
             w._commit_thread is not None and w._commit_thread.is_alive()
             for w in server.workers
         )
-        if busy == 0 and server.plan_queue.depth() == 0 and not threads_busy:
+        defrag_busy = not server.defrag.drained()
+        if (
+            busy == 0
+            and server.plan_queue.depth() == 0
+            and not threads_busy
+            and not defrag_busy
+        ):
             calm += 1
             if calm >= 3:  # stable across three polls, not a gap between ops
                 return True
@@ -253,13 +287,19 @@ def run_chaos(
     quiesce_timeout: float = 60.0,
     num_batch_workers: int = 1,
     incremental: Optional[bool] = None,
+    defrag_interval: float = 0.05,
 ) -> ChaosRun:
     """One full chaos cycle: boot, inject, quiesce, check, tear down.
 
     ``incremental`` pins the score-state cache (device/cache.py) on or
     off for the run; None inherits the ambient NOMAD_TPU_INCREMENTAL
     resolution. Chaos runs with it on exercise cache.score_refresh_drop
-    and the score half of invariant law 12."""
+    and the score half of invariant law 12.
+
+    ``defrag_interval`` enables continuous defragmentation for the run
+    (server/defrag.py) so live migration churns concurrently with the
+    workload and the ``migrate.*`` fault sites land on real two-phase
+    moves; ``<= 0`` turns the controller's periodic scan off."""
     import os
 
     from ..obs.recorder import flight_recorder
@@ -304,6 +344,11 @@ def run_chaos(
             # deterministic unit test — see tests/test_chaos.py)
             heartbeat_ttl=3600.0,
             clock=plane.clock,
+            # continuous defrag runs hot so bounded live migration —
+            # and the migrate.* fault sites — interleave with the
+            # op stream (law 16, migration_conservation)
+            defrag_interval=defrag_interval,
+            defrag_budget=2,
         )
     )
     broker = server.eval_broker
@@ -326,6 +371,12 @@ def run_chaos(
         # the delayed heap drains at normal speed now
         if not quiesced:
             quiesced = _quiesce(server, 10.0)
+        # no new moves past this point; a kill_mid_move that landed on
+        # the *last* defrag cycle left a committed half-move with no
+        # next cycle to recover it — finish phase B synchronously so
+        # law 16 judges a settled cluster, not a mid-flight one
+        server.defrag.stop()
+        server.defrag.recover()
         report = check_cluster(server, plane=plane, baseline=baseline)
         report.info["quiesced"] = quiesced
         report.info["batch_workers"] = num_batch_workers

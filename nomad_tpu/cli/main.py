@@ -1288,6 +1288,33 @@ def cmd_operator_raft_remove(args) -> int:
     return 0
 
 
+def cmd_operator_defrag(args) -> int:
+    """`nomad-tpu operator defrag [--trigger|--pause|--resume]` — the
+    live-migration control plane: status/counters by default, or poke
+    the controller (server/defrag.py)."""
+    c = _client(args)
+    if args.pause or args.resume:
+        st = c._request(
+            "POST", "/v1/operator/defrag", body={"paused": bool(args.pause)}
+        )
+        print(f"==> defrag {'paused' if st['paused'] else 'resumed'}")
+    elif args.trigger:
+        st = c._request("POST", "/v1/operator/defrag", body={})
+        print("==> defrag cycle triggered")
+    else:
+        st = c._request("GET", "/v1/operator/defrag")
+    mode = "continuous" if st.get("enabled") else "on-demand"
+    if st.get("paused"):
+        mode += " (paused)"
+    print(f"==> defrag: {mode}  interval={st.get('interval')}s  "
+          f"budget={st.get('budget')} moves/cycle")
+    print(f"    packing efficiency: {st.get('packing_efficiency')}")
+    print(f"    cycles with moves:  {st.get('cycles')}")
+    for k, v in sorted((st.get("counters") or {}).items()):
+        print(f"    {k}: {v:g}")
+    return 0
+
+
 def cmd_operator_scheduler(args) -> int:
     c = _client(args)
     if args.algorithm:
@@ -1643,6 +1670,14 @@ def build_parser() -> argparse.ArgumentParser:
     osave.set_defaults(fn=cmd_operator_snapshot_save)
     omet = op.add_parser("metrics")
     omet.set_defaults(fn=cmd_operator_metrics)
+    odefrag = op.add_parser(
+        "defrag",
+        help="live-migration status; --trigger runs a cycle now",
+    )
+    odefrag.add_argument("--trigger", action="store_true")
+    odefrag.add_argument("--pause", action="store_true")
+    odefrag.add_argument("--resume", action="store_true")
+    odefrag.set_defaults(fn=cmd_operator_defrag)
 
     system = sub.add_parser("system", help="system commands").add_subparsers(
         dest="sub", required=True

@@ -340,12 +340,17 @@ def _cp_topo_quant(w):
     return xp.round(w * TOPO_WEIGHT_SCALE).astype(xp.int32)
 
 
-def _cp_topo_term(q_rack, q_pod, mates_rack, mates_pod):
-    """f32[G, N] signed topology term: all-integer weighted sum, then
-    one exact power-of-two rescale — bitwise identical under any mesh
-    partitioning."""
+def _cp_topo_term(q_rack, q_pod, q_ici, mates_rack, mates_pod, mates_ici):
+    """f32[G, N] signed topology term: all-integer weighted sum over the
+    three levels (rack, pod, ici — the normalized ICI-hop-distance
+    coordinate), then one exact power-of-two rescale — bitwise identical
+    under any mesh partitioning."""
     xp = np if isinstance(mates_rack, np.ndarray) else jnp
-    acc = q_rack[:, None] * mates_rack + q_pod[:, None] * mates_pod
+    acc = (
+        q_rack[:, None] * mates_rack
+        + q_pod[:, None] * mates_pod
+        + q_ici[:, None] * mates_ici
+    )
     return acc.astype(xp.float32) * xp.float32(1.0 / TOPO_WEIGHT_SCALE)
 
 
@@ -366,8 +371,10 @@ def cp_gang_place_kernel(
     gang,  # i32[G] gang ids (0 = not ganged)
     w_rack,  # f32[G] signed rack-level topology weight (+colocate/−spread)
     w_pod,  # f32[G] signed pod-level topology weight
+    w_ici,  # f32[G] signed ici-level topology weight (hop-distance slice)
     rack_oh,  # i32[N, R] one-hot rack ids (col 0 zeroed)
     pod_oh,  # i32[N, P] one-hot pod ids (col 0 zeroed)
+    ici_oh,  # i32[N, I] one-hot ici slice ids (col 0 zeroed)
     lam0,  # f32[N]
     steps: int,
     max_c: int,
@@ -395,6 +402,7 @@ def cp_gang_place_kernel(
     same_gang = _cp_gang_same(gang)
     q_rack = _cp_topo_quant(w_rack)
     q_pod = _cp_topo_quant(w_pod)
+    q_ici = _cp_topo_quant(w_ici)
 
     def cond(carry):
         it, progress = carry[0], carry[1]
@@ -410,7 +418,10 @@ def cp_gang_place_kernel(
         active = placed < counts
         mates_rack = _cp_topo_mates(same_gang, assigned, rack_oh)
         mates_pod = _cp_topo_mates(same_gang, assigned, pod_oh)
-        topo = _cp_topo_term(q_rack, q_pod, mates_rack, mates_pod)
+        mates_ici = _cp_topo_mates(same_gang, assigned, ici_oh)
+        topo = _cp_topo_term(
+            q_rack, q_pod, q_ici, mates_rack, mates_pod, mates_ici
+        )
         umask = jnp.where(
             feas, _cp_gang_priced(scores, lam, sib_other, topo), _NEG_INF
         )
@@ -472,8 +483,10 @@ def oracle_cp_gang_place(
     gang: np.ndarray,
     w_rack: np.ndarray,
     w_pod: np.ndarray,
+    w_ici: np.ndarray,
     rack_oh: np.ndarray,
     pod_oh: np.ndarray,
+    ici_oh: np.ndarray,
     lam0: np.ndarray,
     steps: int,
     max_c: int,
@@ -486,6 +499,7 @@ def oracle_cp_gang_place(
     same_gang = _cp_gang_same(gang)
     q_rack = _cp_topo_quant(w_rack)
     q_pod = _cp_topo_quant(w_pod)
+    q_ici = _cp_topo_quant(w_ici)
     used = used0.astype(np.float32).copy()
     placed = np.zeros(g, dtype=np.int32)
     assigned = np.zeros((g, n), dtype=np.int32)
@@ -505,7 +519,10 @@ def oracle_cp_gang_place(
         active = placed < counts
         mates_rack = _cp_topo_mates(same_gang, assigned, rack_oh)
         mates_pod = _cp_topo_mates(same_gang, assigned, pod_oh)
-        topo = _cp_topo_term(q_rack, q_pod, mates_rack, mates_pod)
+        mates_ici = _cp_topo_mates(same_gang, assigned, ici_oh)
+        topo = _cp_topo_term(
+            q_rack, q_pod, q_ici, mates_rack, mates_pod, mates_ici
+        )
         umask = np.where(
             feas, _cp_gang_priced(scores, lam, sib_other, topo), _NEG_INF
         )

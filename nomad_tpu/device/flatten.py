@@ -100,8 +100,10 @@ class ClusterTensors:
     # all-zero columns).
     topo_rack_ids: np.ndarray | None = None  # i32[N]
     topo_pod_ids: np.ndarray | None = None  # i32[N]
+    topo_ici_ids: np.ndarray | None = None  # i32[N]
     topo_rack_vocab: dict[str, int] = field(default_factory=lambda: {"": 0})
     topo_pod_vocab: dict[str, int] = field(default_factory=lambda: {"": 0})
+    topo_ici_vocab: dict[str, int] = field(default_factory=lambda: {"": 0})
     # row-ordered Node objects (nodes[i] ↔ row i); kept in sync by the
     # flattener / DeviceStateCache so host-side per-class constraint
     # evaluation never re-sorts the cluster
@@ -176,22 +178,31 @@ class ClusterTensors:
         """True when any node declares a non-empty device_class."""
         return len(self.device_class_vocab) > 1
 
-    def topology_columns(self) -> tuple[np.ndarray, np.ndarray]:
-        """Per-node (rack_ids, pod_ids) i32 columns (id 0 = no
+    def topology_columns(
+        self,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Per-node (rack_ids, pod_ids, ici_ids) i32 columns (id 0 = no
         coordinate). The factored per-level form of the topology
         distance matrix: two rows are rack-adjacent iff their rack ids
-        match, pod-adjacent iff their pod ids match — N two-column
-        entries instead of an N×N hop matrix."""
+        match, pod-adjacent iff their pod ids match, ici-adjacent iff
+        their normalized ICI-hop-distance slice ids match — N
+        three-column entries instead of an N×N hop matrix."""
         if self.topo_rack_ids is None:
             self.topo_rack_ids = np.zeros(self.padded_n, dtype=np.int32)
         if self.topo_pod_ids is None:
             self.topo_pod_ids = np.zeros(self.padded_n, dtype=np.int32)
-        return self.topo_rack_ids, self.topo_pod_ids
+        if self.topo_ici_ids is None:
+            self.topo_ici_ids = np.zeros(self.padded_n, dtype=np.int32)
+        return self.topo_rack_ids, self.topo_pod_ids, self.topo_ici_ids
 
     @property
     def has_topology(self) -> bool:
-        """True when any node declares rack/pod coordinates."""
-        return len(self.topo_rack_vocab) > 1 or len(self.topo_pod_vocab) > 1
+        """True when any node declares rack/pod/ici coordinates."""
+        return (
+            len(self.topo_rack_vocab) > 1
+            or len(self.topo_pod_vocab) > 1
+            or len(self.topo_ici_vocab) > 1
+        )
 
 
 def flatten_cluster(snap, nodes=None) -> ClusterTensors:
@@ -226,8 +237,10 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
     device_class_vocab: dict[str, int] = {"": 0}
     topo_rack_ids = np.zeros(pn, dtype=np.int32)
     topo_pod_ids = np.zeros(pn, dtype=np.int32)
+    topo_ici_ids = np.zeros(pn, dtype=np.int32)
     topo_rack_vocab: dict[str, int] = {"": 0}
     topo_pod_vocab: dict[str, int] = {"": 0}
+    topo_ici_vocab: dict[str, int] = {"": 0}
     region_ids = np.full(pn, -1, dtype=np.int32)
     region_vocab: dict[str, int] = {}
 
@@ -248,6 +261,9 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
         )
         topo_pod_ids[i] = topo_pod_vocab.setdefault(
             topo.get("pod", ""), len(topo_pod_vocab)
+        )
+        topo_ici_ids[i] = topo_ici_vocab.setdefault(
+            topo.get("ici", ""), len(topo_ici_vocab)
         )
         if not node.computed_class:
             node.compute_class()
@@ -278,8 +294,10 @@ def flatten_cluster(snap, nodes=None) -> ClusterTensors:
         device_class_vocab=device_class_vocab,
         topo_rack_ids=topo_rack_ids,
         topo_pod_ids=topo_pod_ids,
+        topo_ici_ids=topo_ici_ids,
         topo_rack_vocab=topo_rack_vocab,
         topo_pod_vocab=topo_pod_vocab,
+        topo_ici_vocab=topo_ici_vocab,
         region_ids=region_ids,
         region_vocab=region_vocab,
     )
@@ -407,6 +425,7 @@ class GroupAsk:
     gang_member: bool = False
     gang_weight_rack: float = 0.0
     gang_weight_pod: float = 0.0
+    gang_weight_ici: float = 0.0
 
     @property
     def has_spreads(self) -> bool:
@@ -895,7 +914,7 @@ def flatten_group_ask(
         c.operand == "distinct_hosts" for c in job.constraints_for_group(tg)
     )
     throughputs, has_tp = job_throughput_vector(ct, job)
-    gang_member, gw_rack, gw_pod = gang_terms(job, tg.name)
+    gang_member, gw_rack, gw_pod, gw_ici = gang_terms(job, tg.name)
 
     return GroupAsk(
         job_id=job.id,
@@ -919,23 +938,24 @@ def flatten_group_ask(
         gang_member=gang_member,
         gang_weight_rack=gw_rack,
         gang_weight_pod=gw_pod,
+        gang_weight_ici=gw_ici,
     )
 
 
-def gang_terms(job, tg_name: str) -> tuple[bool, float, float]:
+def gang_terms(job, tg_name: str) -> tuple[bool, float, float, float]:
     """Resolve one group's gang membership + signed per-level topology
     weights from the job's gang stanza. Non-members (and gang-less jobs)
-    get (False, 0.0, 0.0) — the zero that keeps every pre-gang path
-    untouched."""
+    get (False, 0.0, 0.0, 0.0) — the zero that keeps every pre-gang
+    path untouched."""
     gang = getattr(job, "gang", None) or {}
     groups = gang.get("groups") or []
     if tg_name not in groups:
-        return False, 0.0, 0.0
-    weights = {"rack": 0.0, "pod": 0.0}
+        return False, 0.0, 0.0, 0.0
+    weights = {"rack": 0.0, "pod": 0.0, "ici": 0.0}
     colocate = gang.get("colocate") or {}
     if colocate.get("level") in weights:
         weights[colocate["level"]] = float(colocate.get("weight", 1.0))
     spread = gang.get("spread") or {}
     if spread.get("level") in weights:
         weights[spread["level"]] = -float(spread.get("weight", 1.0))
-    return True, weights["rack"], weights["pod"]
+    return True, weights["rack"], weights["pod"], weights["ici"]

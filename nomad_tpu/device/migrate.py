@@ -1,0 +1,240 @@
+"""Bounded-budget migration planning on the dense (allocs × nodes) grid.
+
+Sustained churn rots packing quality (the soak harness proves it);
+production fleets recover it with live migration — Tesserae's placement
+policies (PAPERS.md, arxiv 2508.04953) are explicitly migration-aware.
+This module is the device half of that plane: given the dense score
+matrix over CANDIDATE allocs (rows) and nodes (columns), select a
+bounded set of moves maximizing score-delta gain minus a per-alloc
+migration cost, with the same auction machinery as ``device/cp.py``:
+
+  1. price the grid: ``gain[a, n] = score[a, n] − cur_score[a]
+     − move_cost[a] − λ[n]`` (λ = per-node congestion price, risen by
+     exact integer claim counts × a power-of-two step — bitwise
+     portable, no transcendentals, no float reductions);
+  2. a move is feasible only where the REPLACEMENT fits on top of the
+     node's committed ``used`` — the source node is never credited back
+     inside the pass (capacity conservation: during a two-phase move
+     the old alloc still runs while the replacement starts, so the
+     conservative "used only increases" model is exactly the mid-move
+     capacity invariant the defrag controller enforces, law 16);
+  3. every unmoved alloc claims its argmax positive-gain node; each
+     contested node admits one claimant per round (highest priced gain,
+     first index on ties — ``_cp_winners`` with a flat priority row);
+  4. an exclusive integer prefix over node index caps committed moves
+     at ``budget`` (a *dynamic* operand, so sweeping budgets never
+     retraces); λ rises on contested nodes / decays on idle ones and
+     the loop repeats until a round commits nothing or budget is spent.
+
+Byte-parity discipline (device/cp.py's contract): the jitted kernel
+(``lax.while_loop``) and the NumPy host oracle share one round's math
+through the ``_mig_*``/``_cp_*`` helpers; every carried value is
+f32/i32, every op elementwise/argmax/integer-sum/integer-cumsum, and
+ties break on the first index in both argmax implementations. The
+parity tests compare uint32 views across seeds and meshes.
+
+Only ``server/defrag.py`` (the DefragController), ``scheduler/
+migrate.py`` (batch assembly + the A/B harness), and the jaxlint
+exercise fleet may call into this module — lint rule NTA021
+(MigrationSeamDiscipline) polices the scheduler/server side.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..utils.backend import traced_jit
+from .cp import _NEG_INF, ETA, _cp_winners
+
+import jax
+import jax.numpy as jnp
+
+
+def _mig_feasible(capacity, used, sizes, eligible, cur, gain, arange_n):
+    """bool[A, N]: replacement fits on top of committed ``used`` ∧
+    eligible ∧ not the current node ∧ the move has strictly positive
+    priced gain (a move that doesn't pay for itself is infeasible, not
+    merely unattractive — it must never win by default)."""
+    xp = np if isinstance(capacity, np.ndarray) else jnp
+    proposed = used[None, :, :] + sizes[:, None, :]  # [A, N, D]
+    fits = xp.all(proposed <= capacity[None, :, :], axis=-1)
+    not_cur = cur[:, None] != arange_n[None, :]
+    return fits & eligible & not_cur & (gain > xp.float32(0.0))
+
+
+def _mig_gain(scores, cur_scores, move_cost, lam):
+    """f32[A, N] priced move gain (all elementwise — bitwise portable)."""
+    return scores - cur_scores[:, None] - move_cost[:, None] - lam[None, :]
+
+
+def _mig_allow(has, claim, moves, budget):
+    """bool[A] per-claimant budget admission: an exclusive integer
+    prefix (cumsum) over node index ranks this round's winning nodes;
+    only the first ``budget − moves`` of them commit. Integer cumsum is
+    exact and associative — byte-portable across meshes."""
+    xp = np if isinstance(claim, np.ndarray) else jnp
+    has_i = has.astype(xp.int32)
+    rank = xp.cumsum(has_i) - has_i  # exclusive prefix over nodes
+    allow_node = (moves + rank) < budget
+    return allow_node[claim]
+
+
+@functools.partial(traced_jit, retrace_budget=16, static_argnames=("steps",))
+def migrate_plan_kernel(
+    capacity,  # f32[N, D]
+    used0,  # f32[N, D] committed usage (sources NOT pre-freed)
+    sizes,  # f32[A, D] per-alloc resource vectors
+    cur,  # i32[A] current node row per candidate alloc
+    eligible,  # bool[A, N] feasibility mask for the replacement
+    scores,  # f32[A, N] dense score matrix (same finals binpack ranks by)
+    cur_scores,  # f32[A] score at the alloc's current node
+    move_cost,  # f32[A] per-alloc migration cost (priced against gain)
+    budget,  # i32 max moves this plan (dynamic operand — no retraces)
+    lam0,  # f32[N] initial prices (zeros; chaos perturbs)
+    steps: int,
+):
+    """Auction rounds on device. Returns (dest i32[A] (-1 = stay),
+    gains f32[A] (0 where staying), used f32[N, D] with every planned
+    replacement committed, moves i32, rounds i32, lam f32[N])."""
+    a, n = scores.shape
+    arange_a = jnp.arange(a)
+    arange_n = jnp.arange(n)
+    prio = jnp.zeros(a, dtype=jnp.float32)  # flat: pure gain elections
+
+    def cond(carry):
+        it, progress = carry[0], carry[1]
+        return (it < steps) & progress
+
+    def body(carry):
+        it, _, rounds, used, dest, gains, moves, lam = carry
+        gain = _mig_gain(scores, cur_scores, move_cost, lam)
+        feas = _mig_feasible(
+            capacity, used, sizes, eligible, cur, gain, arange_n
+        )
+        active = dest < 0
+        umask = jnp.where(feas, gain, _NEG_INF)
+        claim, claimable, won, win, has, claims = _cp_winners(
+            umask, feas, active, prio, arange_a, arange_n
+        )
+        allow = _mig_allow(has, claim, moves, budget)
+        won = won & allow
+        has_won = has & ((moves + jnp.cumsum(has.astype(jnp.int32))
+                          - has.astype(jnp.int32)) < budget)
+        # commit: ≤1 replacement per node per round, winners only up to
+        # the budget — used only ever increases inside a pass, so every
+        # planned move's replacement fits while its old alloc still runs
+        delta = jnp.where(has_won[:, None], sizes[win], jnp.float32(0.0))
+        used = used + delta
+        dest = jnp.where(won, claim, dest)
+        gains = jnp.where(won, gain[arange_a, claim], gains)
+        moves = moves + won.astype(jnp.int32).sum()
+        lam = lam + ETA * jnp.maximum(claims - 1, 0).astype(jnp.float32)
+        lam = jnp.where(
+            claims == 0, jnp.maximum(lam - ETA, jnp.float32(0.0)), lam
+        )
+        progress = jnp.any(claimable) & (moves < budget)
+        rounds = rounds + jnp.any(claimable).astype(jnp.int32)
+        return (it + 1, progress, rounds, used, dest, gains, moves, lam)
+
+    carry = (
+        jnp.int32(0),
+        jnp.bool_(True),
+        jnp.int32(0),
+        used0,
+        jnp.full(a, -1, dtype=jnp.int32),
+        jnp.zeros(a, dtype=jnp.float32),
+        jnp.int32(0),
+        lam0,
+    )
+    out = jax.lax.while_loop(cond, body, carry)
+    _, _, rounds, used, dest, gains, moves, lam = out
+    return dest, gains, used, moves, rounds, lam
+
+
+def oracle_migrate_plan(
+    capacity: np.ndarray,
+    used0: np.ndarray,
+    sizes: np.ndarray,
+    cur: np.ndarray,
+    eligible: np.ndarray,
+    scores: np.ndarray,
+    cur_scores: np.ndarray,
+    move_cost: np.ndarray,
+    budget: int,
+    lam0: np.ndarray,
+    steps: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int, int, np.ndarray]:
+    """Pure-NumPy host oracle: the same round math as the device kernel,
+    stepwise. Byte-identical output is the contract (tests/test_migrate.py
+    pins uint32 views across seeds and meshes, like cp's oracle)."""
+    a, n = scores.shape
+    arange_a = np.arange(a)
+    arange_n = np.arange(n)
+    prio = np.zeros(a, dtype=np.float32)
+    used = used0.astype(np.float32).copy()
+    dest = np.full(a, -1, dtype=np.int32)
+    gains = np.zeros(a, dtype=np.float32)
+    lam = lam0.astype(np.float32).copy()
+    budget = np.int32(budget)
+    moves = np.int32(0)
+    it = 0
+    rounds = 0
+    progress = True
+    while it < steps and progress:
+        gain = _mig_gain(scores, cur_scores, move_cost, lam)
+        feas = _mig_feasible(
+            capacity, used, sizes, eligible, cur, gain, arange_n
+        )
+        active = dest < 0
+        umask = np.where(feas, gain, _NEG_INF)
+        claim, claimable, won, win, has, claims = _cp_winners(
+            umask, feas, active, prio, arange_a, arange_n
+        )
+        allow = _mig_allow(has, claim, moves, budget)
+        won = won & allow
+        has_won = has & ((moves + np.cumsum(has.astype(np.int32))
+                          - has.astype(np.int32)) < budget)
+        delta = np.where(has_won[:, None], sizes[win], np.float32(0.0))
+        used = used + delta
+        dest = np.where(won, claim, dest)
+        gains = np.where(won, gain[arange_a, claim], gains)
+        moves = np.int32(moves + won.astype(np.int32).sum())
+        lam = lam + ETA * np.maximum(claims - 1, 0).astype(np.float32)
+        lam = np.where(
+            claims == 0, np.maximum(lam - ETA, np.float32(0.0)), lam
+        )
+        progress = bool(claimable.any()) and bool(moves < budget)
+        rounds += int(claimable.any())
+        it += 1
+    return dest, gains, used, int(moves), rounds, lam
+
+
+def packing_efficiency(
+    capacity: np.ndarray, used: np.ndarray, ready: np.ndarray
+) -> float:
+    """Fleet packing efficiency in [0, 1]: how many ready nodes are
+    COMPLETELY empty versus the most that could be, were the current
+    load repacked perfectly (per-dim ceiling over a homogeneous fleet's
+    max node capacity). 1.0 = load is as consolidated as arithmetic
+    allows; fragmented fleets score low because load is smeared thinly
+    across many nodes. The defrag gate measures recovery of this gauge."""
+    ready = np.asarray(ready, dtype=bool)
+    cap = np.asarray(capacity, dtype=np.float64)[ready]
+    use = np.asarray(used, dtype=np.float64)[ready]
+    n = int(ready.sum())
+    if n == 0:
+        return 1.0
+    total = use.sum(axis=0)
+    per_node = cap.max(axis=0)
+    need = 0
+    for d in range(cap.shape[1]):
+        if per_node[d] <= 0.0:
+            continue
+        need = max(need, int(np.ceil(total[d] / per_node[d])))
+    ideal_empty = n - min(need, n)
+    if ideal_empty <= 0:
+        return 1.0
+    empty = int((use.sum(axis=1) == 0.0).sum())
+    return float(empty) / float(ideal_empty)

@@ -98,6 +98,18 @@ SLO_SCHEMA = tuple(sorted(
         "gang.fallback_failures",
     ]
     + [
+        "defrag.moves_planned",
+        "defrag.moves_completed",
+        "defrag.moves_aborted",
+        "defrag.moves_interrupted",
+        "defrag.moves_recovered",
+        "defrag.budget_exhausted_cycles",
+        "defrag.capacity_violations",
+        "defrag.packing_efficiency",
+        "defrag.drain_migrated",
+        "defrag.drain_force_stops",
+    ]
+    + [
         "ring_coverage.traces_recorded",
         "ring_coverage.traces_evicted",
         "ring_coverage.coverage",
@@ -425,6 +437,30 @@ class SloCollector:
                 "fallback_failures": _delta(
                     "nomad.cp.gang_fallback_failures"
                 ),
+            },
+            # migration-plane health (server/defrag.py, law 16): the
+            # move ledger as windowed deltas, the packing-efficiency
+            # gauge as-is, and the drain split — graceful migrations vs
+            # deadline force-stops — that the drainer reports
+            "defrag": {
+                "moves_planned": _delta("nomad.migrate.planned"),
+                "moves_completed": _delta("nomad.migrate.completed"),
+                "moves_aborted": _delta("nomad.migrate.aborted"),
+                "moves_interrupted": _delta("nomad.migrate.interrupted"),
+                "moves_recovered": _delta("nomad.migrate.recovered"),
+                "budget_exhausted_cycles": _delta(
+                    "nomad.migrate.budget_exhausted"
+                ),
+                "capacity_violations": _delta(
+                    "nomad.migrate.capacity_violations"
+                ),
+                "packing_efficiency": round(
+                    self._metrics.snapshot()["gauges"].get(
+                        "nomad.migrate.packing_efficiency", 1.0
+                    ), 6,
+                ),
+                "drain_migrated": _delta("nomad.drain.migrated"),
+                "drain_force_stops": _delta("nomad.drain.force_stops"),
             },
             "calibration": self._calibration_block(),
             "device_cache": self._device_cache_block(),

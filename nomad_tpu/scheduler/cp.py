@@ -303,8 +303,10 @@ class GangInputs:
     gang: np.ndarray  # i32[G] gang ids (0 = not in a gang)
     w_rack: np.ndarray  # f32[G] signed rack weight
     w_pod: np.ndarray  # f32[G] signed pod weight
+    w_ici: np.ndarray  # f32[G] signed ici weight
     rack_oh: np.ndarray  # i32[N, R] one-hot rack ids (col 0 zeroed)
     pod_oh: np.ndarray  # i32[N, P] one-hot pod ids (col 0 zeroed)
+    ici_oh: np.ndarray  # i32[N, I] one-hot ici slice ids (col 0 zeroed)
     job_of: dict  # gang id → job id
     members: dict  # gang id → [tg_name, ...]
 
@@ -318,6 +320,7 @@ def build_gang_inputs(cluster, asks: list) -> GangInputs:
     gang = np.zeros(g, dtype=np.int32)
     w_rack = np.zeros(g, dtype=np.float32)
     w_pod = np.zeros(g, dtype=np.float32)
+    w_ici = np.zeros(g, dtype=np.float32)
     codes: dict[str, int] = {}
     members: dict[int, list] = {}
     for i, a in enumerate(asks):
@@ -327,16 +330,20 @@ def build_gang_inputs(cluster, asks: list) -> GangInputs:
         gang[i] = gid
         w_rack[i] = np.float32(a.gang_weight_rack)
         w_pod[i] = np.float32(a.gang_weight_pod)
+        w_ici[i] = np.float32(getattr(a, "gang_weight_ici", 0.0))
         members.setdefault(gid, []).append(a.tg_name)
-    rack_ids, pod_ids = cluster.topology_columns()
+    rack_ids, pod_ids, ici_ids = cluster.topology_columns()
     rw = _steps_bucket(max(int(rack_ids.max(initial=0)) + 1, 2))
     pw = _steps_bucket(max(int(pod_ids.max(initial=0)) + 1, 2))
+    iw = _steps_bucket(max(int(ici_ids.max(initial=0)) + 1, 2))
     return GangInputs(
         gang=gang,
         w_rack=w_rack,
         w_pod=w_pod,
+        w_ici=w_ici,
         rack_oh=topo_onehot(np.asarray(rack_ids, dtype=np.int32), rw),
         pod_oh=topo_onehot(np.asarray(pod_ids, dtype=np.int32), pw),
+        ici_oh=topo_onehot(np.asarray(ici_ids, dtype=np.int32), iw),
         job_of={v: k for k, v in codes.items()},
         members=members,
     )
@@ -406,8 +413,10 @@ class CpGangPlacementKernel(CpPlacementKernel):
             gi.gang,
             gi.w_rack,
             gi.w_pod,
+            gi.w_ici,
             shard_put(gi.rack_oh, ("nodes",), cfg),
             shard_put(gi.pod_oh, ("nodes",), cfg),
+            shard_put(gi.ici_oh, ("nodes",), cfg),
             batch.lam0,
             steps=batch.steps,
             max_c=batch.max_c,
@@ -468,8 +477,10 @@ class CpGangPlacementKernel(CpPlacementKernel):
             topo_final = _cp_topo_term(
                 _cp_topo_quant(gi.w_rack),
                 _cp_topo_quant(gi.w_pod),
+                _cp_topo_quant(gi.w_ici),
                 _cp_topo_mates(same, assigned, gi.rack_oh),
                 _cp_topo_mates(same, assigned, gi.pod_oh),
+                _cp_topo_mates(same, assigned, gi.ici_oh),
             )
         results = []
         for i, a in enumerate(asks):
@@ -748,10 +759,18 @@ def build_topo_fleet(
         np.int32
     )
     pod_of = (rack_of * pods // max(racks, 1)).astype(np.int32)
+    # ici slices halve each rack: the normalized ICI-hop-distance
+    # coordinate (client/fingerprint.py) — nodes in one slice are one
+    # ICI hop apart, the tightest co-location level the pricer sees
+    ici_of = (np.arange(n_nodes) * racks * 2 // max(n_nodes, 1)).astype(
+        np.int32
+    )
     topo_rack_ids = np.zeros(pn, dtype=np.int32)
     topo_rack_ids[:n_nodes] = rack_of + 1
     topo_pod_ids = np.zeros(pn, dtype=np.int32)
     topo_pod_ids[:n_nodes] = pod_of + 1
+    topo_ici_ids = np.zeros(pn, dtype=np.int32)
+    topo_ici_ids[:n_nodes] = ici_of + 1
     return ClusterTensors(
         node_ids=[f"node-{i}" for i in range(n_nodes)],
         index=1,
@@ -767,8 +786,12 @@ def build_topo_fleet(
         node_row={f"node-{i}": i for i in range(n_nodes)},
         topo_rack_ids=topo_rack_ids,
         topo_pod_ids=topo_pod_ids,
+        topo_ici_ids=topo_ici_ids,
         topo_rack_vocab={"": 0, **{f"r{r:02d}": r + 1 for r in range(racks)}},
         topo_pod_vocab={"": 0, **{f"p{p}": p + 1 for p in range(pods)}},
+        topo_ici_vocab={
+            "": 0, **{f"i{s:02d}": s + 1 for s in range(racks * 2)}
+        },
     )
 
 
@@ -806,6 +829,10 @@ def build_gang_asks(
                     gang_member=True,
                     gang_weight_rack=2.0 if colocate else 0.0,
                     gang_weight_pod=0.0 if colocate else -1.0,
+                    # colocating gangs also price the tighter ici slice
+                    # — the third level — so the rack win prefers the
+                    # one-hop half of the rack when room allows
+                    gang_weight_ici=0.5 if colocate else 0.0,
                 )
             )
     return asks
@@ -843,8 +870,10 @@ def _gang_quality(ct, asks, results, gi: GangInputs,
     topo_final = _cp_topo_term(
         _cp_topo_quant(gi.w_rack),
         _cp_topo_quant(gi.w_pod),
+        _cp_topo_quant(gi.w_ici),
         _cp_topo_mates(same, assigned, gi.rack_oh),
         _cp_topo_mates(same, assigned, gi.pod_oh),
+        _cp_topo_mates(same, assigned, gi.ici_oh),
     )
     # each placed instance values the topology term at its node; self
     # pairs count once per instance on both sides (shared across A/B,
@@ -852,7 +881,7 @@ def _gang_quality(ct, asks, results, gi: GangInputs,
     topo_value = float(
         (topo_final * (assigned > 0) * assigned).astype(np.float64).sum()
     )
-    rack_ids, pod_ids = ct.topology_columns()
+    rack_ids, pod_ids, _ici_ids = ct.topology_columns()
     gangs_intact = 0
     topology_satisfied = 0
     fragmented = 0
@@ -917,7 +946,8 @@ def run_gang_ab(
             batch.capacity, batch.used, batch.asks, batch.counts,
             batch.eligible, batch.scores, batch.prio, batch.job_counts,
             batch.distinct, batch.jobgrp, gi2.gang, gi2.w_rack,
-            gi2.w_pod, gi2.rack_oh, gi2.pod_oh, batch.lam0,
+            gi2.w_pod, gi2.w_ici, gi2.rack_oh, gi2.pod_oh,
+            gi2.ici_oh, batch.lam0,
         )
         d = cp_gang_place_kernel(
             *args, steps=batch.steps, max_c=batch.max_c

@@ -31,6 +31,7 @@ from typing import Optional
 from ..structs import Evaluation
 from ..structs.alloc import DesiredTransition
 from ..structs.evaluation import EVAL_STATUS_PENDING, TRIGGER_NODE_DRAIN
+from ..utils.metrics import global_metrics as metrics
 
 log = logging.getLogger("nomad_tpu.drainer")
 
@@ -118,6 +119,9 @@ class NodeDrainer:
             for a, job in remaining:
                 if not a.desired_transition.migrate:
                     transitions[a.id] = DesiredTransition(migrate=True)
+                    # deadline expiry is a forced exit, not a graceful
+                    # wave — the SLO surface tracks the ratio
+                    metrics.incr("nomad.drain.force_stops")
                 jobs_touched[(a.namespace, a.job_id)] = job
         else:
             # Wave scheduling per (job, group) — watch_jobs.go
@@ -139,6 +143,7 @@ class NodeDrainer:
                     for a, _ in pairs:
                         if not a.desired_transition.migrate:
                             transitions[a.id] = DesiredTransition(migrate=True)
+                            metrics.incr("nomad.drain.migrated")
                     jobs_touched[(ns, job_id)] = None
                     continue
                 tg = job.lookup_task_group(tg_name)
@@ -163,6 +168,7 @@ class NodeDrainer:
                     if a.desired_transition.migrate:
                         continue
                     transitions[a.id] = DesiredTransition(migrate=True)
+                    metrics.incr("nomad.drain.migrated")
                     jobs_touched[(ns, job_id)] = job
                     num_to_mark -= 1
 
@@ -208,3 +214,8 @@ class NodeDrainer:
             {"deadline_reached": deadlined},
         )
         log.info("node %s drain complete (deadlined=%s)", node.id, deadlined)
+        # a freed node is prime repacking space — nudge the defrag
+        # controller (no-op unless continuous defrag is enabled)
+        defrag = getattr(self.server, "defrag", None)
+        if defrag is not None:
+            defrag.notify_drain_complete()

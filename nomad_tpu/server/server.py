@@ -61,6 +61,8 @@ class ServerConfig:
         eval_attempt_limit: Optional[int] = None,
         admission_overrides: Optional[dict] = None,
         calibration_artifact: Optional[str] = None,
+        defrag_interval: float = 0.0,
+        defrag_budget: int = 4,
     ):
         import os
 
@@ -119,6 +121,12 @@ class ServerConfig:
         # measured sustainable rate (source: probe). None = shipped
         # defaults.
         self.calibration_artifact = calibration_artifact
+        # continuous defragmentation (server/defrag.py): periodic live
+        # migration of allocs onto fewer nodes, bounded moves per cycle.
+        # <= 0 keeps the periodic scan off (explicit operator triggers
+        # still work); budget caps moves per cycle.
+        self.defrag_interval = defrag_interval
+        self.defrag_budget = defrag_budget
 
 
 class Server:
@@ -215,6 +223,13 @@ class Server:
         from .periodic import PeriodicDispatch
 
         self.drainer = NodeDrainer(self)
+        from .defrag import DefragController
+
+        self.defrag = DefragController(
+            self,
+            interval=self.config.defrag_interval,
+            budget=self.config.defrag_budget,
+        )
         self.heartbeater = NodeHeartbeater(
             self,
             ttl=self.config.heartbeat_ttl,
@@ -402,6 +417,7 @@ class Server:
         self.heartbeater.start()
         self.deployment_watcher.start()
         self.drainer.start()
+        self.defrag.start()
         self.periodic.restore()
         self.periodic.start()
         self.core_gc.start()
@@ -419,6 +435,7 @@ class Server:
         self.heartbeater.stop()
         self.deployment_watcher.stop()
         self.drainer.stop()
+        self.defrag.stop()
         self.periodic.stop()
         self.core_gc.stop()
         self.volume_watcher.stop()

@@ -412,7 +412,7 @@ def validate_throughputs(throughputs: dict) -> list[str]:
     return problems
 
 
-GANG_TOPOLOGY_LEVELS = ("rack", "pod")
+GANG_TOPOLOGY_LEVELS = ("rack", "pod", "ici")
 
 
 def validate_gang(gang: dict, group_names=None) -> list[str]:

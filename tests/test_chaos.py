@@ -358,6 +358,47 @@ class TestChaosRunner:
         assert active_plane() is None
 
 
+class TestMigrationFaults:
+    """Defrag two-phase moves under the fault plane (law 16)."""
+
+    def test_move_drop_commits_nothing(self):
+        run = _small_run(
+            7, steps=60,
+            schedule=[FaultSpec("migrate.move_drop", 0, "drop")],
+        )
+        assert run.ok, run.render()
+        assert ("migrate.move_drop", 0, "drop") in run.triggered
+        c = run.report.info["counters"]
+        assert c.get("nomad.migrate.aborted", 0) >= 1
+        # the dropped move left nothing behind for law 16 to tolerate
+        assert run.report.checked["migration_conservation"]
+        assert c.get("nomad.migrate.capacity_violations", 0) == 0
+
+    def test_kill_mid_move_recovered_never_doubled(self):
+        run = _small_run(
+            11, steps=60,
+            schedule=[FaultSpec("migrate.kill_mid_move", 0, "drop")],
+        )
+        assert run.ok, run.render()
+        assert ("migrate.kill_mid_move", 0, "drop") in run.triggered
+        c = run.report.info["counters"]
+        # phase B was lost once; the recovery scan finished exactly that
+        # half-move — law 16 (count + mid-move capacity) stays green
+        assert c.get("nomad.migrate.interrupted", 0) >= 1
+        assert c.get("nomad.migrate.recovered", 0) >= 1
+        assert c.get("nomad.migrate.capacity_violations", 0) == 0
+        assert run.report.checked["migration_conservation"]
+
+    def test_migration_exercised_in_default_mix(self):
+        # no explicit schedule: the seeded default mix must still drive
+        # real moves, and the law judges them at every quiesce point
+        run = _small_run(11, steps=60)
+        assert run.ok, run.render()
+        c = run.report.info["counters"]
+        assert c.get("nomad.migrate.planned", 0) >= 1
+        assert run.report.checked["migration_conservation"]
+
+
 @pytest.mark.slow
 class TestChaosSoak:
     def test_twenty_seed_matrix(self):

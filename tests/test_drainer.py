@@ -11,9 +11,17 @@ import time
 import pytest
 
 from nomad_tpu import mock
+from nomad_tpu.chaos import FaultPlane, FaultSpec, install, uninstall
 from nomad_tpu.server.server import Server, ServerConfig
 from nomad_tpu.structs import DrainStrategy
 from nomad_tpu.structs.job import MigrateStrategy
+from nomad_tpu.utils.metrics import global_metrics
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plane():
+    yield
+    uninstall()
 
 
 @pytest.fixture
@@ -253,3 +261,157 @@ def test_drain_ignore_system_jobs(server):
     )
     remaining = live_allocs_on(server, victim.id)
     assert remaining and all(a.job_id == sysjob.id for a in remaining)
+
+
+# -- wave migration under the fault plane (chaos-matrix coverage) ------------
+
+
+def _counter(name: str) -> float:
+    return global_metrics.snapshot()["counters"].get(name, 0.0)
+
+
+def _job_converged(server, job, count):
+    allocs = [
+        a
+        for a in server.store.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status() and a.desired_status == "run"
+    ]
+    return len(allocs) == count
+
+
+class TestDrainerChaos:
+    def test_kill_mid_wave_still_converges(self, server):
+        """A worker thread killed while committing a wave's replacement
+        plan must not lose the wave: the eval is redelivered, the drain
+        completes, the job lands at full count off the victim."""
+        n1, n2 = mock.node(), mock.node()
+        server.register_node(n1)
+        server.register_node(n2)
+        job = mock.job()
+        job.task_groups[0].count = 4
+        job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+        server.register_job(job)
+        assert server.wait_for_evals(10)
+        victim = max(
+            (n1, n2), key=lambda n: len(server.store.allocs_by_node(n.id))
+        )
+        if not live_allocs_on(server, victim.id):
+            pytest.skip("all allocs landed on one node unexpectedly")
+        assert wait_until(
+            lambda: all(
+                a.client_status == "running"
+                for a in server.store.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()
+            )
+        )
+
+        install(FaultPlane(schedule=[
+            FaultSpec("worker.commit", 0, "kill"),
+            FaultSpec("plan_queue.enqueue_merged", 1, "kill"),
+        ]))
+        try:
+            server.update_node_drain(
+                victim.id, DrainStrategy(deadline_s=3600)
+            )
+            assert wait_until(
+                lambda: not live_allocs_on(server, victim.id), timeout=15
+            )
+            assert wait_until(
+                lambda: _job_converged(server, job, 4), timeout=15
+            )
+        finally:
+            uninstall()
+        for a in server.store.allocs_by_job(job.namespace, job.id):
+            if not a.terminal_status():
+                assert a.node_id != victim.id
+        # graceful waves only: no deadline fired, so no forced exits
+        assert _counter("nomad.drain.migrated") >= 1
+
+    def test_deadline_expiry_under_dropped_delivery(self, server):
+        """A dropped eval delivery slows the waves past the deadline;
+        the force-drain sweep must still empty the node and account its
+        exits as force_stops, not clean migrations."""
+        n1, n2 = mock.node(), mock.node()
+        server.register_node(n1)
+        server.register_node(n2)
+        job = mock.job()
+        job.task_groups[0].count = 4
+        job.task_groups[0].migrate = MigrateStrategy(max_parallel=1)
+        server.register_job(job)
+        assert server.wait_for_evals(10)
+        victim = max(
+            (n1, n2), key=lambda n: len(server.store.allocs_by_node(n.id))
+        )
+        if not live_allocs_on(server, victim.id):
+            pytest.skip("all allocs landed on one node unexpectedly")
+        forced0 = _counter("nomad.drain.force_stops")
+
+        # the dropped delivery redelivers via the unack deadline — pull
+        # it down from the production 60s so the test converges fast
+        server.eval_broker.unack_timeout = 1.0
+        install(FaultPlane(schedule=[
+            FaultSpec("broker.dequeue", 0, "drop"),
+        ]))
+        try:
+            server.update_node_drain(
+                victim.id, DrainStrategy(deadline_s=0.3)
+            )
+            assert wait_until(
+                lambda: _counter("nomad.drain.force_stops") > forced0,
+                timeout=15,
+            )
+            assert wait_until(
+                lambda: not live_allocs_on(server, victim.id), timeout=15
+            )
+        finally:
+            uninstall()
+        assert wait_until(
+            lambda: server.store.node_by_id(victim.id).drain is None
+        )
+
+    def test_paired_node_flap_during_drain(self, server):
+        """The destination node flaps (down, back up) mid-drain: the
+        drain must still complete and the job converge at full count —
+        no alloc stranded on the victim, none double-placed."""
+        n1, n2, n3 = mock.node(), mock.node(), mock.node()
+        for n in (n1, n2, n3):
+            server.register_node(n)
+        job = mock.job()
+        job.task_groups[0].count = 6
+        job.task_groups[0].migrate = MigrateStrategy(max_parallel=2)
+        server.register_job(job)
+        assert server.wait_for_evals(10)
+        victim = max(
+            (n1, n2, n3),
+            key=lambda n: len(server.store.allocs_by_node(n.id)),
+        )
+        partner = next(n for n in (n1, n2, n3) if n.id != victim.id)
+        assert wait_until(
+            lambda: all(
+                a.client_status == "running"
+                for a in server.store.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()
+            )
+        )
+
+        server.update_node_drain(victim.id, DrainStrategy(deadline_s=3600))
+        time.sleep(0.2)  # let the first wave land somewhere
+        server.update_node_status(partner.id, "down")
+        time.sleep(0.2)
+        server.update_node_status(partner.id, "ready")
+        server.store.node_by_id(partner.id)
+
+        assert wait_until(
+            lambda: not live_allocs_on(server, victim.id), timeout=20
+        )
+        assert wait_until(
+            lambda: _job_converged(server, job, 6), timeout=20
+        )
+        # exactly-once accounting: every live alloc is on a ready,
+        # non-draining node
+        for a in server.store.allocs_by_job(job.namespace, job.id):
+            if a.terminal_status():
+                continue
+            assert a.node_id != victim.id
+            node = server.store.node_by_id(a.node_id)
+            assert node.status == "ready"

@@ -73,7 +73,7 @@ def _gang_io(batch, gi):
         batch.capacity, batch.used, batch.asks, batch.counts,
         batch.eligible, batch.scores, batch.prio, batch.job_counts,
         batch.distinct, batch.jobgrp, gi.gang, gi.w_rack, gi.w_pod,
-        gi.rack_oh, gi.pod_oh, batch.lam0,
+        gi.w_ici, gi.rack_oh, gi.pod_oh, gi.ici_oh, batch.lam0,
     )
 
 
@@ -124,7 +124,9 @@ job "train" {
         bad = self.HCL.replace('level = "rack"', 'level = "row"')
         with pytest.raises(JobspecError) as e:
             parse_job_file(bad)
-        assert "gang.colocate.level must be one of rack/pod" in str(e.value)
+        assert "gang.colocate.level must be one of rack/pod/ici" in str(
+            e.value
+        )
 
     @pytest.mark.parametrize(
         "gang,needle",
@@ -139,8 +141,10 @@ job "train" {
                 "gang.groups lists 'a' twice",
             ),
             (
-                {"groups": ["a"], "spread": {"level": "ici"}},
-                "gang.spread.level must be one of rack/pod, got 'ici'",
+                # ici is a real level now (hop-distance pricing) — an
+                # unknown level still rejects
+                {"groups": ["a"], "spread": {"level": "row"}},
+                "gang.spread.level must be one of rack/pod/ici, got 'row'",
             ),
             (
                 {

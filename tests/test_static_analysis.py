@@ -35,6 +35,7 @@ from nomad_tpu.analysis.rules.shardingseam import ShardingSeamDiscipline
 from nomad_tpu.analysis.rules.solverseam import SolverSeamDiscipline
 from nomad_tpu.analysis.rules.spans import SpanCoverage
 from nomad_tpu.analysis.rules.topologyseam import TopologySeamDiscipline
+from nomad_tpu.analysis.rules.migrationseam import MigrationSeamDiscipline
 from nomad_tpu.analysis.rules.swallow import SilentExceptionSwallow
 from nomad_tpu.analysis.rules.wallclock import BareWallClockInBrokerServer
 from nomad_tpu.utils import backend
@@ -937,6 +938,69 @@ class TestNTA020:
             ), rel
 
 
+class TestNTA021:
+    BAD = (
+        "from ..device.migrate import oracle_migrate_plan\n"
+        "from ..scheduler.migrate import build_defrag_batch\n"
+        "def fast_moves(capacity, used, sizes, cur, budget, lam0, steps):\n"
+        "    args = build_defrag_batch(capacity, used, sizes, cur)\n"
+        "    return oracle_migrate_plan(*args, budget, lam0, steps)\n"
+    )
+
+    def test_direct_migrate_call_in_scheduler_triggers(self):
+        fs = run(self.BAD, "nomad_tpu/scheduler/shortcut.py",
+                 MigrationSeamDiscipline)
+        assert rule_ids(fs) == ["NTA021", "NTA021"]
+        assert fs[0].symbol == "fast_moves"
+
+    def test_direct_kernel_call_in_server_triggers(self):
+        src = (
+            "from ..device.migrate import migrate_plan_kernel\n"
+            "def shortcut(args, budget, lam0):\n"
+            "    return migrate_plan_kernel(*args, budget, lam0, steps=8)\n"
+        )
+        fs = run(src, "nomad_tpu/server/fastmove.py",
+                 MigrationSeamDiscipline)
+        assert rule_ids(fs) == ["NTA021"]
+
+    def test_controller_routed_moves_are_clean(self):
+        src = (
+            "def repack(server):\n"
+            "    return server.defrag.run_cycle()\n"
+        )
+        assert run(src, "nomad_tpu/server/custom.py",
+                   MigrationSeamDiscipline) == []
+
+    def test_defrag_seams_are_exempt(self):
+        for rel in (
+            "nomad_tpu/scheduler/migrate.py",
+            "nomad_tpu/server/defrag.py",
+        ):
+            assert run(self.BAD, rel, MigrationSeamDiscipline) == []
+
+    def test_device_package_is_out_of_scope(self):
+        # parity pinning calls the kernel and oracle directly by design
+        assert run(self.BAD, "nomad_tpu/device/parity.py",
+                   MigrationSeamDiscipline) == []
+
+    def test_scheduler_and_server_at_head_are_clean(self):
+        """Zero direct migration-plane invocations to ratchet: every
+        mover goes through the DefragController."""
+        for rel in (
+            ("nomad_tpu", "scheduler", "generic.py"),
+            ("nomad_tpu", "scheduler", "system.py"),
+            ("nomad_tpu", "server", "server.py"),
+            ("nomad_tpu", "server", "drainer.py"),
+            ("nomad_tpu", "server", "worker.py"),
+        ):
+            path = os.path.join(REPO_ROOT, *rel)
+            with open(path) as f:
+                src = f.read()
+            assert (
+                run(src, "/".join(rel), MigrationSeamDiscipline) == []
+            ), rel
+
+
 class TestNTA017:
     def test_bare_jit_call_triggers(self):
         src = (
@@ -1149,7 +1213,7 @@ class TestBaselineRatchet:
             "NTA001", "NTA002", "NTA003", "NTA004", "NTA005", "NTA006",
             "NTA007", "NTA008", "NTA009", "NTA010", "NTA011", "NTA012",
             "NTA013", "NTA014", "NTA015", "NTA016", "NTA017", "NTA018",
-            "NTA019", "NTA020",
+            "NTA019", "NTA020", "NTA021",
         ]
 
 
